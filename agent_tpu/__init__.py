@@ -1,0 +1,32 @@
+"""agent_tpu — a TPU-native distributed job-swarm framework.
+
+A ground-up rebuild of the capabilities of the reference worker agent
+(``distributed-swarm/agent-tpu``): a lease-driven swarm agent that executes
+named ops against a controller's ``/v1/leases`` + ``/v1/results`` protocol —
+re-founded on JAX/XLA over a TPU device mesh instead of a one-row-at-a-time
+host loop around an Edge TPU interpreter.
+
+Layering (bottom-up; see SURVEY.md §7 for the design rationale):
+
+- ``agent_tpu.runtime``    device manager, mesh construction, compiled-op cache
+  (successor of reference ``ops/_tpu_runtime.py``).
+- ``agent_tpu.sizing``     topology-derived batching/sharding + worker profile
+  (successor of reference ``worker_sizing.py``).
+- ``agent_tpu.parallel``   sharding specs, collectives, ring attention, pipeline.
+- ``agent_tpu.models``     tokenizers and Flax model families (encoder, seq2seq, LM).
+- ``agent_tpu.data``       byte-offset CSV sharding + double-buffered prefetch
+  (successor of reference ``ops/csv_shard.py`` skip-scan reader).
+- ``agent_tpu.ops``        the op registry and the op set (successor of reference
+  ``ops/__init__.py`` + ``ops_loader.py`` with its wiring gaps fixed).
+- ``agent_tpu.agent``      the lease→execute→report loop (successor of ``app.py``).
+- ``agent_tpu.controller`` in-repo controller speaking the same wire protocol
+  (not present in the reference; required for a self-contained framework).
+
+This module deliberately imports nothing heavy: importing ``agent_tpu`` must not
+initialize JAX (the reference boots without pycoral for the same reason,
+reference ``ops/_tpu_runtime.py:45-46``).
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["__version__"]

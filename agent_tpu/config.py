@@ -1,0 +1,231 @@
+"""Typed configuration for the whole framework.
+
+The reference configures everything through ~25 environment variables read ad hoc
+at import time (reference ``app.py:19-44``, ``worker_sizing.py:12-41``,
+``ops/_tpu_runtime.py:29``, ``ops/map_summarize.py:9-10``). That env surface is a
+compatibility contract (containers are launched with these vars), so we keep every
+variable name and default — but read them in exactly one place, behind dataclasses,
+at a controlled time (``AgentConfig.from_env()``), never at import.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+
+def env_str(name: str, default: str) -> str:
+    v = os.environ.get(name)
+    return v if v is not None and v != "" else default
+
+
+def env_int(name: str, default: int) -> int:
+    """Forgiving int parse (bad values fall back, like reference worker_sizing.py:12-20)."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(float(v))
+    except (TypeError, ValueError):
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def env_bool(name: str, default: bool) -> bool:
+    """Truthy strings per reference worker_sizing.py:31-41 ("1", "true", "yes", "on")."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on", "y")
+
+
+def parse_labels(raw: str) -> Dict[str, Any]:
+    """``"k=v,k2=v2,flag"`` → ``{"k": "v", "k2": "v2", "flag": True}``.
+
+    Same grammar as the reference label parser (reference ``app.py:49-63``):
+    comma-separated, ``k=v`` pairs become strings, bare tokens become ``True``.
+    """
+    labels: Dict[str, Any] = {}
+    for tok in (raw or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" in tok:
+            k, _, v = tok.partition("=")
+            k, v = k.strip(), v.strip()
+            if k:
+                labels[k] = v
+        else:
+            labels[tok] = True
+    return labels
+
+
+def parse_tasks(raw: str) -> Tuple[str, ...]:
+    """TASKS env → ordered de-duplicated op-name tuple (reference ``app.py:86-98``).
+
+    ``*`` / ``all`` and ``none`` sentinels are preserved verbatim for the registry
+    gate (reference ``ops/__init__.py:42-57``) and resolved there, not here.
+    """
+    seen = []
+    for tok in (raw or "").split(","):
+        tok = tok.strip()
+        if tok and tok not in seen:
+            seen.append(tok)
+    return tuple(seen)
+
+
+@dataclass(frozen=True)
+class AgentConfig:
+    """Control-plane configuration (reference ``app.py:19-44``)."""
+
+    controller_url: str = "http://10.11.12.54:8080"
+    agent_name: str = field(default_factory=socket.gethostname)
+    http_timeout_sec: float = 10.0
+    idle_sleep_sec: float = 0.25
+    # The reference leases one task at a time ("TPU agents should usually lease 1
+    # task at a time", reference app.py:30-31). We keep that default: a task is now
+    # a *batched shard*, so one in-flight task saturates the mesh; raise it to
+    # overlap host staging of the next shard with device compute.
+    max_tasks: int = 1
+    lease_timeout_ms: int = 3000
+    error_log_every_sec: float = 10.0
+    error_backoff_sec: float = 1.0
+    tasks: Tuple[str, ...] = ("echo", "map_classify_tpu")
+    labels: Dict[str, Any] = field(default_factory=dict)
+    tpu_kind: str = "tpu-v5e"
+
+    @staticmethod
+    def from_env() -> "AgentConfig":
+        return AgentConfig(
+            controller_url=env_str("CONTROLLER_URL", "http://10.11.12.54:8080").rstrip("/"),
+            agent_name=env_str("AGENT_NAME", socket.gethostname()),
+            http_timeout_sec=env_float("HTTP_TIMEOUT_SEC", 10.0),
+            idle_sleep_sec=env_float("IDLE_SLEEP_SEC", 0.25),
+            max_tasks=max(1, env_int("MAX_TASKS", 1)),
+            lease_timeout_ms=env_int("LEASE_TIMEOUT_MS", 3000),
+            error_log_every_sec=env_float("ERROR_LOG_EVERY_SEC", 10.0),
+            error_backoff_sec=env_float("ERROR_BACKOFF_SEC", 1.0),
+            tasks=parse_tasks(env_str("TASKS", "echo,map_classify_tpu")),
+            labels=parse_labels(os.environ.get("AGENT_LABELS", "")),
+            tpu_kind=env_str("TPU_KIND", "tpu-v5e"),
+        )
+
+
+@dataclass(frozen=True)
+class DeviceConfig:
+    """Device/runtime configuration (reference ``_tpu_runtime.py:29``,
+    ``worker_sizing.py:195-200,226``, plus new mesh knobs)."""
+
+    model_path: Optional[str] = None          # TPU_MODEL_PATH
+    tpu_disabled: bool = False                # TPU_DISABLED kill-switch
+    tpu_only: bool = False                    # TPU_ONLY scheduling mode
+    platform_hint: Optional[str] = None       # JAX_PLATFORM_NAME (hint, never proof)
+    tpu_name: Optional[str] = None            # TPU_NAME (hint)
+    tpu_type: Optional[str] = None            # TPU_TYPE (hint)
+    # New (TPU-native) knobs. MESH_SHAPE like "dp=2,tp=2,sp=2"; empty → derived
+    # from topology by sizing.
+    mesh_shape: Dict[str, int] = field(default_factory=dict)
+    # Dtype for model compute on device; bf16 is the MXU-native choice.
+    compute_dtype: str = "bfloat16"
+    # Persistent XLA compilation cache directory ("" disables).
+    compile_cache_dir: str = ""
+
+    @staticmethod
+    def from_env() -> "DeviceConfig":
+        mesh: Dict[str, int] = {}
+        for k, v in parse_labels(os.environ.get("MESH_SHAPE", "")).items():
+            try:
+                mesh[k] = int(v)
+            except (TypeError, ValueError):
+                pass
+        return DeviceConfig(
+            model_path=os.environ.get("TPU_MODEL_PATH") or None,
+            tpu_disabled=env_bool("TPU_DISABLED", False),
+            tpu_only=env_bool("TPU_ONLY", False),
+            platform_hint=os.environ.get("JAX_PLATFORM_NAME") or None,
+            tpu_name=os.environ.get("TPU_NAME") or None,
+            tpu_type=os.environ.get("TPU_TYPE") or None,
+            mesh_shape=mesh,
+            compute_dtype=env_str("COMPUTE_DTYPE", "bfloat16"),
+            compile_cache_dir=env_str("JAX_COMPILATION_CACHE_DIR", ""),
+        )
+
+
+@dataclass(frozen=True)
+class SizingConfig:
+    """Host-sizing knobs (reference ``worker_sizing.py:44-124``)."""
+
+    cpu_reserved_cores_floor: int = 1
+    cpu_reserved_cores_cap: int = 4
+    cpu_pipeline_factor: float = 4.0
+    cpu_min_workers: int = 1
+    cpu_soft_cap_multiplier: int = 8
+    cpu_per_worker_bytes: int = 32 * 1024 * 1024
+
+    @staticmethod
+    def from_env() -> "SizingConfig":
+        return SizingConfig(
+            cpu_reserved_cores_floor=env_int("CPU_RESERVED_CORES_FLOOR", 1),
+            cpu_reserved_cores_cap=env_int("CPU_RESERVED_CORES_CAP", 4),
+            cpu_pipeline_factor=env_float("CPU_PIPELINE_FACTOR", 4.0),
+            cpu_min_workers=env_int("CPU_MIN_WORKERS", 1),
+            cpu_soft_cap_multiplier=env_int("CPU_SOFT_CAP_MULTIPLIER", 8),
+            cpu_per_worker_bytes=env_int("CPU_PER_WORKER_BYTES", 32 * 1024 * 1024),
+        )
+
+
+@dataclass(frozen=True)
+class OpsConfig:
+    """Per-op knobs (reference ``ops/map_summarize.py:9-10``, trigger envs)."""
+
+    summarize_model: str = "t5-small-swarm"   # BART_MODEL slot in the reference
+    summarize_force_cpu: bool = True          # SUMMARIZE_FORCE_CPU default on, ref :10
+    sap_host: Optional[str] = None
+    sap_user: Optional[str] = None
+    sap_pass: Optional[str] = None
+    oracle_host: Optional[str] = None
+    oracle_user: Optional[str] = None
+    oracle_pass: Optional[str] = None
+
+    @staticmethod
+    def from_env() -> "OpsConfig":
+        return OpsConfig(
+            summarize_model=env_str("BART_MODEL", "t5-small-swarm"),
+            summarize_force_cpu=env_bool("SUMMARIZE_FORCE_CPU", True),
+            sap_host=os.environ.get("SAP_HOST") or None,
+            sap_user=os.environ.get("SAP_USER") or None,
+            sap_pass=os.environ.get("SAP_PASS") or None,
+            oracle_host=os.environ.get("ORACLE_HOST") or None,
+            oracle_user=os.environ.get("ORA_USER") or None,
+            oracle_pass=os.environ.get("ORA_PASS") or None,
+        )
+
+
+@dataclass(frozen=True)
+class Config:
+    """Aggregate, built once at process start and passed down explicitly."""
+
+    agent: AgentConfig = field(default_factory=AgentConfig)
+    device: DeviceConfig = field(default_factory=DeviceConfig)
+    sizing: SizingConfig = field(default_factory=SizingConfig)
+    ops: OpsConfig = field(default_factory=OpsConfig)
+
+    @staticmethod
+    def from_env() -> "Config":
+        return Config(
+            agent=AgentConfig.from_env(),
+            device=DeviceConfig.from_env(),
+            sizing=SizingConfig.from_env(),
+            ops=OpsConfig.from_env(),
+        )

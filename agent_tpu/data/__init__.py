@@ -1,0 +1,12 @@
+"""Data plane: byte-offset CSV sharding and host→device prefetch.
+
+Successor of the reference's skip-scan CSV reader (reference
+``ops/csv_shard.py:9-26``), which re-reads every row before ``start_row`` on
+each shard — O(N²/shard_size) across a job. Here a quote-aware newline index is
+built once per file (natively in C++ when the extension is built, pure Python
+otherwise) and every shard is a direct byte-range read.
+"""
+
+from agent_tpu.data.csv_index import CsvIndex, read_shard, count_rows
+
+__all__ = ["CsvIndex", "read_shard", "count_rows"]

@@ -1,0 +1,144 @@
+"""Quote-aware byte-offset row index for CSV files.
+
+Design: one linear scan per file builds ``offsets[i]`` = byte offset of the
+start of row ``i`` (row 0 is the header), honoring RFC-4180 quoting so newlines
+inside quoted fields do not split rows (the reference's ``csv.DictReader``
+skip-scan got this right but paid an O(start_row) scan per shard, reference
+``ops/csv_shard.py:18-24``). Shards then become ``file.seek`` + one bounded
+read — O(shard bytes) regardless of position, which is what lets the host side
+keep a TPU fed (BASELINE.json: "csv_shard.py streams shards straight into HBM
+with host-side double buffering").
+
+The scan itself prefers the native C++ scanner (``agent_tpu.data.native``),
+falling back to the pure-Python chunked scanner transparently.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_CHUNK = 1 << 20  # 1 MiB scan chunks
+
+
+def _scan_row_offsets_py(path: str) -> np.ndarray:
+    """Pure-Python quote-aware scan → int64 array of row-start offsets."""
+    offsets: List[int] = [0]
+    in_quote = False
+    pos = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_CHUNK)
+            if not chunk:
+                break
+            for i, b in enumerate(chunk):
+                if b == 0x22:  # '"' — doubled quotes toggle twice, net no-op
+                    in_quote = not in_quote
+                elif b == 0x0A and not in_quote:  # '\n'
+                    offsets.append(pos + i + 1)
+            pos += len(chunk)
+    # Drop a trailing offset pointing at EOF (file ends with newline).
+    if offsets and offsets[-1] >= pos and len(offsets) > 1:
+        offsets.pop()
+    return np.asarray(offsets, dtype=np.int64)
+
+
+def _scan_row_offsets(path: str) -> np.ndarray:
+    try:
+        from agent_tpu.data.native import scan_row_offsets_native
+
+        out = scan_row_offsets_native(path)
+        if out is not None:
+            return out
+    except Exception:  # noqa: BLE001 — native path is best-effort by design
+        pass
+    return _scan_row_offsets_py(path)
+
+
+@dataclass(frozen=True)
+class _Key:
+    path: str
+    size: int
+    mtime_ns: int
+
+
+class CsvIndex:
+    """Per-file row index with process-wide caching.
+
+    The cache is keyed by (path, size, mtime) so a rewritten file re-indexes —
+    the same invalidation idea as the reference's model-path-keyed interpreter
+    singleton (reference ``ops/_tpu_runtime.py:8-13,42-43``), applied to data.
+    """
+
+    _cache: Dict[_Key, "CsvIndex"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, path: str, offsets: np.ndarray, size: int) -> None:
+        self.path = path
+        self.offsets = offsets  # row-start byte offsets; row 0 = header
+        self.size = size
+
+    @classmethod
+    def for_file(cls, path: str) -> "CsvIndex":
+        st = os.stat(path)
+        key = _Key(os.path.abspath(path), st.st_size, st.st_mtime_ns)
+        with cls._lock:
+            idx = cls._cache.get(key)
+        if idx is not None:
+            return idx
+        offsets = _scan_row_offsets(path)
+        idx = cls(path, offsets, st.st_size)
+        with cls._lock:
+            if len(cls._cache) > 64:  # bound memory; files are re-indexable
+                cls._cache.clear()
+            cls._cache[key] = idx
+        return idx
+
+    @property
+    def n_data_rows(self) -> int:
+        """Rows excluding the header line."""
+        return max(0, len(self.offsets) - 1)
+
+    def header(self) -> List[str]:
+        raw = self._read_range(0, 1)
+        return next(csv.reader(io.StringIO(raw)), [])
+
+    def _read_range(self, start_row: int, n_rows: int) -> str:
+        """Read the raw bytes spanning rows [start_row, start_row + n_rows)."""
+        if n_rows <= 0 or start_row >= len(self.offsets):
+            return ""
+        begin = int(self.offsets[start_row])
+        end_idx = start_row + n_rows
+        end = int(self.offsets[end_idx]) if end_idx < len(self.offsets) else self.size
+        with open(self.path, "rb") as f:
+            f.seek(begin)
+            return f.read(end - begin).decode("utf-8", errors="replace")
+
+    def read_dict_rows(self, start_row: int, shard_size: int) -> List[Dict[str, str]]:
+        """Data rows [start_row, start_row+shard_size) as dicts (header keys).
+
+        ``start_row`` counts data rows from 0, matching the reference contract
+        (reference ``ops/csv_shard.py:9-26`` DictReader semantics).
+        """
+        start_row = max(0, start_row)
+        n = min(shard_size, self.n_data_rows - start_row)
+        if n <= 0:
+            return []
+        header = self.header()
+        body = self._read_range(start_row + 1, n)  # +1: skip header row
+        reader = csv.reader(io.StringIO(body))
+        return [dict(zip(header, row)) for row in reader]
+
+
+def read_shard(path: str, start_row: int, shard_size: int) -> List[Dict[str, str]]:
+    return CsvIndex.for_file(path).read_dict_rows(start_row, shard_size)
+
+
+def count_rows(path: str) -> int:
+    return CsvIndex.for_file(path).n_data_rows

@@ -1,0 +1,11 @@
+"""Native (C++) data-plane accelerators, loaded via ctypes.
+
+The reference's native layer was third-party (libedgetpu/tflite, reference
+``Dockerfile:9-30``); ours is in-repo: a quote-aware CSV row scanner compiled
+lazily from ``csv_scan.cpp``. Everything here is best-effort — callers fall
+back to pure Python when the toolchain or the built library is unavailable.
+"""
+
+from agent_tpu.data.native.build import scan_row_offsets_native
+
+__all__ = ["scan_row_offsets_native"]

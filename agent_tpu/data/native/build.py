@@ -1,0 +1,12 @@
+"""Lazy ctypes build/load of the native CSV scanner (placeholder until the
+C++ source lands; returns None so callers use the Python scanner)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def scan_row_offsets_native(path: str) -> Optional[np.ndarray]:
+    return None

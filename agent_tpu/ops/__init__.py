@@ -1,0 +1,155 @@
+"""Op registry and dispatch.
+
+This is the *intended* design of the reference registry (reference
+``ops/__init__.py:35-108`` + ``ops_loader.py``) with its four shipped wiring gaps
+fixed (SURVEY.md §1):
+
+1. The registry is the **only** dispatch table — the agent loop uses it (the
+   reference agent ignored its registry and kept a private 2-entry dict,
+   reference ``app.py:135-138``).
+2. Every entry in ``OP_TO_MODULE`` maps to a module that exists (the reference
+   mapped four phantom modules, reference ``ops/__init__.py:21-25``).
+3. Registered names equal map keys (the reference registered ``read_csv_shard``
+   under map key ``csv_shard``, making the op unreachable both ways,
+   reference ``ops/__init__.py:20`` vs ``ops/csv_shard.py:29``).
+4. The ERP triggers are proper registered ops (the reference shipped them as
+   bare unwired ``run()`` functions, reference ``ops/trigger_sap.py:9``).
+
+Semantics preserved from the reference:
+- ``register_op(name)`` decorator populates the registry at module import
+  (ref ``ops/__init__.py:35-39``).
+- Lazy import: modules load on first ``get_op``; import failures are recorded in
+  ``OPS_LOAD_ERRORS`` and surfaced in rich error messages, never at package
+  import (ref ``ops/__init__.py:74-84``), so the agent boots on hosts missing
+  heavy deps — the moral equivalent of booting without pycoral
+  (ref ``ops/_tpu_runtime.py:45-46``).
+- TASKS gating with ``*``/``all``/``none`` sentinels (ref ``ops/__init__.py:42-71``).
+
+Op call contract: ``fn(payload: dict, ctx: OpContext | None = None) -> dict``.
+The optional ``ctx`` carries the device runtime (mesh, compiled-op cache); pure
+host ops ignore it — same shape as the reference's optional ``ctx`` on the TPU op
+(ref ``ops/map_classify_tpu.py:32``).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+OpFn = Callable[..., Dict[str, Any]]
+
+# name -> handler. Populated by @register_op side effects at module import.
+OPS_REGISTRY: Dict[str, OpFn] = {}
+# [(module_name, repr(error))] — import failures, recorded not raised.
+OPS_LOAD_ERRORS: List[Tuple[str, str]] = []
+
+# Static lazy-import map: op name -> submodule of agent_tpu.ops.
+# Invariant (tested): every module exists and registers exactly its key.
+OP_TO_MODULE: Dict[str, str] = {
+    "echo": "echo",
+    "map_tokenize": "map_tokenize",
+    "map_classify_tpu": "map_classify_tpu",
+    "map_summarize": "map_summarize",
+    "read_csv_shard": "csv_shard",       # name == registered name (gap 3 fixed)
+    "risk_accumulate": "risk_accumulate",
+    "trigger_sap": "trigger_sap",        # now a real registered op (gap 4 fixed)
+    "trigger_oracle": "trigger_oracle",
+}
+
+_imported: Dict[str, bool] = {}
+_lock = threading.Lock()
+
+
+def register_op(name: str) -> Callable[[OpFn], OpFn]:
+    """Decorator: register ``fn`` under ``name`` (ref ops/__init__.py:35-39)."""
+
+    def deco(fn: OpFn) -> OpFn:
+        OPS_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def _parse_tasks_env(raw: Optional[str] = None) -> Optional[List[str]]:
+    """TASKS env → enabled-op filter. None means "no filter" (all enabled).
+
+    Sentinels per reference ``ops/__init__.py:42-57``: ``*`` or ``all`` → all ops;
+    ``none`` → empty set; unset → all.
+    """
+    if raw is None:
+        raw = os.environ.get("TASKS", "")
+    toks = [t.strip() for t in raw.split(",") if t.strip()]
+    if not toks:
+        return None
+    low = [t.lower() for t in toks]
+    if "*" in toks or "all" in low:
+        return None
+    if low == ["none"]:
+        return []
+    return toks
+
+
+def _is_enabled(name: str, tasks: Optional[List[str]] = None) -> bool:
+    enabled = _parse_tasks_env() if tasks is None else (_parse_tasks_env(",".join(tasks)) if tasks else [])
+    return enabled is None or name in enabled
+
+
+def list_ops() -> List[str]:
+    """All known op names, filtered by the TASKS gate (ref ops/__init__.py:60-65)."""
+    enabled = _parse_tasks_env()
+    names = sorted(OP_TO_MODULE)
+    if enabled is None:
+        return names
+    return [n for n in names if n in enabled]
+
+
+def _import_op_module(module: str) -> None:
+    """Import ``agent_tpu.ops.<module>`` once; record failures (ref :74-84)."""
+    with _lock:
+        if _imported.get(module):
+            return
+        try:
+            importlib.import_module(f"agent_tpu.ops.{module}")
+            _imported[module] = True
+        except Exception as exc:  # noqa: BLE001 — deliberately broad, recorded
+            OPS_LOAD_ERRORS.append((module, repr(exc)))
+            _imported[module] = False
+
+
+def get_op(name: str) -> OpFn:
+    """Resolve an op name to its handler, or raise with a rich diagnostic.
+
+    Resolution order mirrors reference ``ops/__init__.py:87-108``:
+    enabled-check → module map → lazy import → registry lookup.
+    """
+    if not _is_enabled(name):
+        raise KeyError(
+            f"op {name!r} is not enabled by TASKS={os.environ.get('TASKS', '')!r}; "
+            f"enabled ops: {list_ops()}"
+        )
+    module = OP_TO_MODULE.get(name)
+    if module is None:
+        raise KeyError(
+            f"unknown op {name!r}; known ops: {sorted(OP_TO_MODULE)}"
+        )
+    _import_op_module(module)
+    fn = OPS_REGISTRY.get(name)
+    if fn is None:
+        errs = "; ".join(f"{m}: {e}" for m, e in OPS_LOAD_ERRORS[:10])
+        raise KeyError(
+            f"op {name!r} did not register (module {module!r}). "
+            f"registered: {sorted(OPS_REGISTRY)}. import errors: {errs or 'none'}"
+        )
+    return fn
+
+
+def load_ops(tasks: List[str]) -> Dict[str, OpFn]:
+    """Resolve a list of op names at startup; raise early on any unknown/disabled
+    name (successor of reference ``ops_loader.py:8-19`` — now actually used by
+    the agent)."""
+    handlers: Dict[str, OpFn] = {}
+    for name in tasks:
+        handlers[name] = get_op(name)
+    return handlers

@@ -1,0 +1,21 @@
+"""Diagnostic echo op — controller↔agent plumbing test.
+
+Capability parity with reference ``ops/echo.py:7-24``: returns the payload
+verbatim under ``echo`` with ``ok: True``, tolerating ``None`` and non-dict
+payloads rather than raising (ref ``:17-22``). Kept host-only on purpose: it
+must work before any device runtime exists, since it is the first op a fresh
+deployment runs (ref ``ops/echo.py:9-14``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from agent_tpu.ops import register_op
+
+
+@register_op("echo")
+def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    if payload is None:
+        payload = {}
+    return {"ok": True, "echo": payload}

@@ -1,0 +1,110 @@
+"""Reduction op: count/sum/mean/min/max over numeric values.
+
+Capability parity with reference ``ops/risk_accumulate.py:18-77``: payload is a
+numeric ``values`` list or an ``items`` list-of-dicts with a ``field`` selector
+(default ``"risk"``, ref ``:44``); result carries ``{count, sum, mean, min, max,
+compute_time_ms}`` with the zero-input shape of ref ``:56-63``. This op is the
+swarm's reduce stage: the controller combines per-shard partials.
+
+The TPU-native upgrade (BASELINE.json north star: "risk_accumulate runs as an
+on-device lax.psum reduction"): when a device runtime ``ctx`` is present and the
+payload is large enough to be worth shipping to HBM, the reduction runs as a
+single jitted ``shard_map`` program whose partials combine with ``lax.psum``
+over the mesh's data axis — see ``agent_tpu.parallel.collectives.mesh_reduce``.
+Small payloads keep the host path (device dispatch would dominate).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from agent_tpu.ops import register_op
+from agent_tpu.utils.errors import bad_input
+
+# Below this many values the host reduce wins; above it the mesh psum path is
+# worth the transfer. Chosen conservatively; bench.py can sweep it.
+DEVICE_THRESHOLD = 4096
+
+
+def _extract_values(payload: Dict[str, Any]) -> List[float]:
+    if "values" in payload:
+        values = payload["values"]
+        if not isinstance(values, list):
+            raise ValueError("values must be a list of numbers")
+        out = []
+        for v in values:
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError("values must be numeric")
+            out.append(float(v))
+        return out
+    if "items" in payload:
+        items = payload["items"]
+        if not isinstance(items, list):
+            raise ValueError("items must be a list of dicts")
+        fieldname = payload.get("field", "risk")
+        out = []
+        for it in items:
+            if not isinstance(it, dict):
+                raise ValueError("items must be dicts")
+            v = it.get(fieldname)
+            if v is None:
+                continue
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(f"field {fieldname!r} must be numeric")
+            out.append(float(v))
+        return out
+    raise ValueError("payload requires 'values' or 'items'")
+
+
+def _zero_result(t0: float) -> Dict[str, Any]:
+    return {
+        "ok": True,
+        "count": 0,
+        "sum": 0.0,
+        "mean": 0.0,
+        "min": None,
+        "max": None,
+        "compute_time_ms": (time.perf_counter() - t0) * 1000.0,
+    }
+
+
+@register_op("risk_accumulate")
+def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    t0 = time.perf_counter()
+    if not isinstance(payload, dict):
+        return bad_input("payload must be a dict")
+    try:
+        values = _extract_values(payload)
+    except ValueError as exc:
+        return bad_input(str(exc))
+    if not values:
+        return _zero_result(t0)
+
+    use_device = (
+        ctx is not None
+        and getattr(ctx, "runtime", None) is not None
+        and len(values) >= payload.get("device_threshold", DEVICE_THRESHOLD)
+    )
+    if use_device:
+        from agent_tpu.parallel.collectives import mesh_reduce_stats
+
+        stats = mesh_reduce_stats(ctx.runtime, values)
+        stats.update(
+            ok=True,
+            device="mesh",
+            compute_time_ms=(time.perf_counter() - t0) * 1000.0,
+        )
+        return stats
+
+    total = math.fsum(values)
+    return {
+        "ok": True,
+        "count": len(values),
+        "sum": total,
+        "mean": total / len(values),
+        "min": min(values),
+        "max": max(values),
+        "compute_time_ms": (time.perf_counter() - t0) * 1000.0,
+    }

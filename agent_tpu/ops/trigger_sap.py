@@ -1,0 +1,55 @@
+"""SAP S/4HANA Quality Notification webhook op.
+
+Capability parity with reference ``ops/trigger_sap.py:9-33`` (an ERP trigger
+posting an OData Quality Notification built from ``{event_type, material,
+text}``, credentials from SAP_HOST/SAP_USER/SAP_PASS) — but properly wired: the
+reference shipped this as a bare ``run()`` with no registration (SURVEY.md §1
+gap 4). Network egress is optional: with no SAP_HOST configured, or with
+``dry_run: true``, the op returns the request it *would* send, which is also
+how tests exercise it hermetically.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from agent_tpu.ops import register_op
+from agent_tpu.utils.errors import bad_input
+
+ODATA_PATH = "/sap/opu/odata/sap/API_QUALITYNOTIFICATION_SRV/A_QualityNotification"
+
+
+@register_op("trigger_sap")
+def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    if not isinstance(payload, dict):
+        return bad_input("payload must be a dict")
+    event_type = payload.get("event_type", "quality_alert")
+    material = payload.get("material")
+    text = payload.get("text", "")
+    if not isinstance(material, str) or not material:
+        return bad_input("material is required and must be a non-empty string")
+
+    host = os.environ.get("SAP_HOST")
+    body = {
+        "NotificationType": "Q1" if event_type == "quality_alert" else "Q2",
+        "Material": material,
+        "NotificationText": str(text)[:40],  # S/4 short-text limit
+    }
+    request = {"method": "POST", "url": f"{host or '<SAP_HOST unset>'}{ODATA_PATH}", "json": body}
+
+    if not host or payload.get("dry_run", False):
+        return {"ok": True, "dry_run": True, "request": request}
+
+    import requests  # lazy: agent boots without it configured
+
+    try:
+        resp = requests.post(
+            f"{host}{ODATA_PATH}",
+            json=body,
+            auth=(os.environ.get("SAP_USER", ""), os.environ.get("SAP_PASS", "")),
+            timeout=10,
+        )
+        return {"ok": resp.status_code < 300, "status": resp.status_code, "request": request}
+    except requests.RequestException as exc:
+        return {"ok": False, "error": f"sap request failed: {exc}", "request": request}

@@ -1,0 +1,6 @@
+"""Shared utilities: structured logging, rate limiting, tracing spans, errors."""
+
+from agent_tpu.utils.logging import RateLimiter, log
+from agent_tpu.utils.errors import OpError, structured_error
+
+__all__ = ["RateLimiter", "log", "OpError", "structured_error"]

@@ -1,0 +1,37 @@
+"""Error contracts.
+
+Two error surfaces, matching the reference's split:
+
+- Ops return ``{"ok": False, "error": "..."}`` for *bad input* instead of raising
+  (reference ``ops/csv_shard.py:46-76``, ``ops/map_tokenize.py:25-32``).
+- The agent loop converts *raised* exceptions into a structured
+  ``{"type", "message", "trace"}`` error shipped with a ``failed`` result
+  (reference ``app.py:288-294``).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Dict
+
+
+class OpError(Exception):
+    """Raised by ops for contract violations that should fail the task."""
+
+
+def structured_error(exc: BaseException) -> Dict[str, Any]:
+    """Exception → the wire error shape the controller expects (ref app.py:290-294)."""
+    return {
+        "type": type(exc).__name__,
+        "message": str(exc),
+        "trace": "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__)
+        )[-4000:],
+    }
+
+
+def bad_input(message: str, **extra: Any) -> Dict[str, Any]:
+    """The ops-level soft-failure shape (ref ops/map_tokenize.py:25-32)."""
+    out: Dict[str, Any] = {"ok": False, "error": message}
+    out.update(extra)
+    return out

@@ -1,0 +1,53 @@
+"""Structured stdout logging with per-key rate limiting.
+
+The reference logs with a ``[agent-tpu-v1]`` prefix, ``flush=True`` (reference
+``app.py:255,311-315``; ``PYTHONUNBUFFERED=1`` in its Dockerfile), and rate-limits
+error logs per category key so a dead controller doesn't flood stdout (reference
+``app.py:66-71``, keys like ``lease``/``result``/``exec`` at ``:261,274,308,313``).
+Both behaviors are kept; the prefix is bumped for the new framework.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, Optional
+
+PREFIX = "[agent-tpu]"
+
+
+def log(msg: str, **fields: Any) -> None:
+    """Print a prefixed, flushed log line; keyword fields render as compact JSON."""
+    if fields:
+        try:
+            tail = " " + json.dumps(fields, sort_keys=True, default=str)
+        except (TypeError, ValueError):
+            tail = " " + repr(fields)
+    else:
+        tail = ""
+    print(f"{PREFIX} {msg}{tail}", flush=True)
+
+
+class RateLimiter:
+    """Per-key 'at most once every N seconds' gate (reference ``app.py:66-71``)."""
+
+    def __init__(self, every_sec: float = 10.0, clock=time.monotonic) -> None:
+        self.every_sec = float(every_sec)
+        self._clock = clock
+        self._last: Dict[str, float] = {}
+
+    def ready(self, key: str) -> bool:
+        now = self._clock()
+        last = self._last.get(key)
+        if last is not None and (now - last) < self.every_sec:
+            return False
+        self._last[key] = now
+        return True
+
+    def log(self, key: str, msg: str, **fields: Any) -> bool:
+        """Log if the key's window has elapsed; returns whether it logged."""
+        if not self.ready(key):
+            return False
+        log(f"{key}: {msg}", **fields)
+        return True

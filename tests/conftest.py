@@ -1,0 +1,31 @@
+"""Test environment: force the CPU backend with 8 virtual devices.
+
+Per SURVEY.md §4.3, all mesh/sharding/collective logic is exercised hermetically
+on a virtual multi-chip mesh (``--xla_force_host_platform_device_count=8``) so CI
+needs no TPU; TPU is a backend switch. This must run before anything imports
+jax, hence module-level in conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_csv(tmp_path):
+    """A small CSV with quoted commas and a quoted embedded newline."""
+    path = tmp_path / "data.csv"
+    rows = ['id,text,risk']
+    for i in range(25):
+        rows.append(f'{i},"row {i}, text",{i * 0.5}')
+    # Row with an embedded newline inside quotes (index 25).
+    rows.append('25,"line one\nline two",12.5')
+    path.write_text("\n".join(rows) + "\n", encoding="utf-8")
+    return str(path)

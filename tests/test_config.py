@@ -1,0 +1,62 @@
+"""Config surface tests — the env-var schema is the compatibility contract
+(SURVEY.md §5.6)."""
+
+import agent_tpu
+from agent_tpu.config import (
+    AgentConfig,
+    Config,
+    DeviceConfig,
+    parse_labels,
+    parse_tasks,
+)
+
+
+def test_version():
+    assert agent_tpu.__version__
+
+
+def test_parse_labels_grammar():
+    # Same grammar as reference app.py:49-63.
+    assert parse_labels("k=v, zone=us, flag") == {"k": "v", "zone": "us", "flag": True}
+    assert parse_labels("") == {}
+    assert parse_labels(",,") == {}
+    assert parse_labels("a=1=2") == {"a": "1=2"}
+
+
+def test_parse_tasks_dedup_order():
+    assert parse_tasks("echo,map_classify_tpu,echo") == ("echo", "map_classify_tpu")
+    assert parse_tasks("") == ()
+
+
+def test_agent_config_defaults(monkeypatch):
+    for var in ("CONTROLLER_URL", "MAX_TASKS", "TASKS"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = AgentConfig.from_env()
+    assert cfg.controller_url == "http://10.11.12.54:8080"  # ref app.py:21-23
+    assert cfg.max_tasks == 1  # ref app.py:30-31
+    assert cfg.tasks == ("echo", "map_classify_tpu")  # ref app.py:38
+    assert cfg.lease_timeout_ms == 3000
+    assert cfg.idle_sleep_sec == 0.25
+
+
+def test_env_overrides(monkeypatch):
+    monkeypatch.setenv("CONTROLLER_URL", "http://ctrl:9999/")
+    monkeypatch.setenv("MAX_TASKS", "3")
+    monkeypatch.setenv("TASKS", "echo,risk_accumulate")
+    monkeypatch.setenv("MESH_SHAPE", "dp=2,tp=4")
+    cfg = Config.from_env()
+    assert cfg.agent.controller_url == "http://ctrl:9999"
+    assert cfg.agent.max_tasks == 3
+    assert cfg.agent.tasks == ("echo", "risk_accumulate")
+    assert cfg.device.mesh_shape == {"dp": 2, "tp": 4}
+
+
+def test_forgiving_parses(monkeypatch):
+    # Bad values fall back to defaults (reference worker_sizing.py:12-41).
+    monkeypatch.setenv("MAX_TASKS", "not-a-number")
+    monkeypatch.setenv("HTTP_TIMEOUT_SEC", "")
+    monkeypatch.setenv("TPU_DISABLED", "yes")
+    cfg = Config.from_env()
+    assert cfg.agent.max_tasks == 1
+    assert cfg.agent.http_timeout_sec == 10.0
+    assert cfg.device.tpu_disabled is True

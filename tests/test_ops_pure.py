@@ -1,0 +1,121 @@
+"""Pure-op payload-contract tests, straight from SURVEY.md §2.3's tables."""
+
+from agent_tpu.ops.echo import run as echo
+from agent_tpu.ops.map_tokenize import run as tokenize
+from agent_tpu.ops.risk_accumulate import run as risk
+from agent_tpu.ops.csv_shard import run as csv_shard
+from agent_tpu.ops.trigger_sap import run as sap
+from agent_tpu.ops.trigger_oracle import run as oracle
+
+
+class TestEcho:
+    def test_roundtrip(self):
+        assert echo({"a": 1}) == {"ok": True, "echo": {"a": 1}}
+
+    def test_tolerates_none_and_nondict(self):
+        # ref ops/echo.py:17-22
+        assert echo(None) == {"ok": True, "echo": {}}
+        assert echo([1, 2])["echo"] == [1, 2]
+
+
+class TestTokenize:
+    def test_chars_mode_parity(self):
+        # Reference behavior: fixed char windows (ref ops/map_tokenize.py:24).
+        out = tokenize({"text": "ab" * 700, "mode": "chars"})
+        assert out["ok"] and out["n_chunks"] == 2
+        assert len(out["chunks"][0]) == 1024 and len(out["chunks"][1]) == 376
+
+    def test_chars_items(self):
+        out = tokenize({"items": ["x" * 2500, "y"], "mode": "chars", "chunk_size": 1000})
+        assert out["counts"] == [3, 1] and out["n_chunks"] == 4
+
+    def test_tokens_mode_default(self):
+        out = tokenize({"items": ["hello world", "hi"]})
+        assert out["ok"] and out["mode"] == "tokens"
+        assert out["token_counts"] == [11, 2]  # byte tokenizer
+        assert out["n_tokens"] == 13
+
+    def test_validation_soft_errors(self):
+        assert tokenize(None)["ok"] is False
+        assert tokenize({"chunk_size": -1, "text": "x"})["ok"] is False
+        assert tokenize({"items": [1]})["ok"] is False
+        assert tokenize({"mode": "bogus", "text": "x"})["ok"] is False
+
+
+class TestRisk:
+    def test_values(self):
+        out = risk({"values": [1, 2, 3, 4]})
+        assert out["ok"] and out["count"] == 4
+        assert out["sum"] == 10.0 and out["mean"] == 2.5
+        assert out["min"] == 1.0 and out["max"] == 4.0
+        assert "compute_time_ms" in out
+
+    def test_items_field(self):
+        # default field "risk" (ref ops/risk_accumulate.py:44); None skipped.
+        out = risk({"items": [{"risk": 2.0}, {"risk": 4.0}, {"other": 9}]})
+        assert out["count"] == 2 and out["mean"] == 3.0
+
+    def test_zero_input_shape(self):
+        # ref ops/risk_accumulate.py:56-63
+        out = risk({"values": []})
+        assert out == {**out, "count": 0, "sum": 0.0, "mean": 0.0, "min": None, "max": None}
+
+    def test_validation(self):
+        assert risk({"values": "nope"})["ok"] is False
+        assert risk({"values": [1, "x"]})["ok"] is False
+        assert risk({})["ok"] is False
+
+
+class TestCsvShard:
+    def test_rows_mode(self, tmp_csv):
+        out = csv_shard({"source_uri": tmp_csv, "start_row": 5, "shard_size": 3})
+        assert out["ok"] and out["count"] == 3
+        assert out["rows"][0]["id"] == "5"
+        assert out["rows"][0]["text"] == "row 5, text"  # quoted comma preserved
+        assert out["total_rows"] == 26
+
+    def test_quoted_newline_row(self, tmp_csv):
+        out = csv_shard({"source_uri": tmp_csv, "start_row": 25, "shard_size": 5})
+        assert out["count"] == 1
+        assert out["rows"][0]["text"] == "line one\nline two"
+
+    def test_count_mode_and_task_wrapping(self, tmp_csv):
+        # payload may arrive wrapped in a task dict (ref ops/csv_shard.py:51)
+        out = csv_shard({"payload": {"source_uri": tmp_csv, "mode": "count", "shard_size": 1000}})
+        assert out["ok"] and out["count"] == 26
+
+    def test_past_end(self, tmp_csv):
+        out = csv_shard({"source_uri": tmp_csv, "start_row": 100, "shard_size": 10})
+        assert out["ok"] and out["rows"] == [] and out["count"] == 0
+
+    def test_validation(self, tmp_csv):
+        assert csv_shard({})["ok"] is False
+        assert csv_shard({"source_uri": tmp_csv, "start_row": -1})["ok"] is False
+        assert csv_shard({"source_uri": tmp_csv, "shard_size": 0})["ok"] is False
+        assert csv_shard({"source_uri": tmp_csv, "mode": "bogus"})["ok"] is False
+        assert csv_shard({"source_uri": "/no/such/file.csv"})["ok"] is False
+
+    def test_file_uri(self, tmp_csv):
+        out = csv_shard({"source_uri": f"file://{tmp_csv}", "shard_size": 1})
+        assert out["ok"] and out["count"] == 1
+
+
+class TestTriggers:
+    def test_sap_dry_run(self, monkeypatch):
+        monkeypatch.delenv("SAP_HOST", raising=False)
+        out = sap({"event_type": "quality_alert", "material": "M-100", "text": "defect"})
+        assert out["ok"] and out["dry_run"]
+        assert out["request"]["json"]["Material"] == "M-100"
+
+    def test_sap_validation(self):
+        assert sap({})["ok"] is False
+
+    def test_oracle_dry_run(self, monkeypatch):
+        monkeypatch.delenv("ORACLE_HOST", raising=False)
+        out = oracle({"event": "inventory_adjustment", "item": "I-7", "qty": 5})
+        assert out["ok"] and out["dry_run"]
+        assert out["request"]["json"]["TransactionQuantity"] == 5
+
+    def test_oracle_validation(self):
+        assert oracle({"item": "", "qty": 1})["ok"] is False
+        assert oracle({"item": "x", "qty": "many"})["ok"] is False

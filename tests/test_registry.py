@@ -1,0 +1,64 @@
+"""Registry wiring invariants — the reference's four wiring gaps, as tests
+(SURVEY.md §1). These are regression tests for design bugs we must not
+reintroduce."""
+
+import pytest
+
+import agent_tpu.ops as ops_pkg
+from agent_tpu.ops import (
+    OP_TO_MODULE,
+    OPS_LOAD_ERRORS,
+    OPS_REGISTRY,
+    get_op,
+    list_ops,
+    load_ops,
+)
+
+
+def test_every_mapped_module_exists_and_registers_its_key(monkeypatch):
+    """Gaps 2+3: no phantom modules, registered name == map key."""
+    monkeypatch.delenv("TASKS", raising=False)
+    for name in OP_TO_MODULE:
+        fn = get_op(name)
+        assert callable(fn), name
+        assert name in OPS_REGISTRY, name
+    assert OPS_LOAD_ERRORS == []
+
+
+def test_unknown_op_rich_error(monkeypatch):
+    monkeypatch.delenv("TASKS", raising=False)
+    with pytest.raises(KeyError) as ei:
+        get_op("fibonacci")  # a phantom op the reference mapped (ref ops/__init__.py:21-25)
+    assert "known ops" in str(ei.value)
+
+
+def test_tasks_gating(monkeypatch):
+    monkeypatch.setenv("TASKS", "echo")
+    assert list_ops() == ["echo"]
+    get_op("echo")
+    with pytest.raises(KeyError) as ei:
+        get_op("risk_accumulate")
+    assert "not enabled" in str(ei.value)
+    monkeypatch.setenv("TASKS", "*")
+    assert set(list_ops()) == set(OP_TO_MODULE)
+    monkeypatch.setenv("TASKS", "none")
+    assert list_ops() == []
+
+
+def test_load_ops_resolves_and_raises_early(monkeypatch):
+    monkeypatch.delenv("TASKS", raising=False)
+    handlers = load_ops(["echo", "risk_accumulate"])
+    assert set(handlers) == {"echo", "risk_accumulate"}
+    with pytest.raises(KeyError):
+        load_ops(["echo", "no_such_op"])
+
+
+def test_agent_uses_this_registry():
+    """Gap 1: the agent loop must dispatch through this registry, not a private
+    table (the reference kept a 2-entry inline OPS dict, ref app.py:135-138)."""
+    import inspect
+
+    from agent_tpu.agent import app as agent_app
+
+    src = inspect.getsource(agent_app)
+    assert "load_ops" in src

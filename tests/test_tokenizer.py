@@ -1,0 +1,65 @@
+"""Tokenizer + padding-bucket tests (static shapes are what keep pjit from
+retracing — SURVEY.md §7 hard parts)."""
+
+import numpy as np
+
+from agent_tpu.models.tokenizer import (
+    BOS_ID,
+    EOS_ID,
+    PAD_ID,
+    UNK_ID,
+    ByteTokenizer,
+    WordPieceTokenizer,
+    bucket_length,
+    pad_batch,
+)
+
+
+def test_byte_roundtrip():
+    tok = ByteTokenizer()
+    for text in ["hello world", "unicode: ü≈ 🙂", ""]:
+        assert tok.decode(tok.encode(text)) == text
+    ids = tok.encode("hi", add_bos=True, add_eos=True)
+    assert ids[0] == BOS_ID and ids[-1] == EOS_ID
+    assert tok.vocab_size == 260
+
+
+def test_wordpiece_train_encode_decode():
+    corpus = ["the quick brown fox", "the lazy dog", "quick quick fox"]
+    tok = WordPieceTokenizer.train(corpus, vocab_size=256)
+    ids = tok.encode("the quick fox")
+    assert all(i != UNK_ID for i in ids)
+    assert tok.decode(ids) == "the quick fox"
+    # Unseen word decomposes into character pieces, not UNK.
+    ids2 = tok.encode("dogfox")
+    assert UNK_ID not in ids2
+
+
+def test_wordpiece_save_load(tmp_path):
+    tok = WordPieceTokenizer.train(["alpha beta gamma"], vocab_size=64)
+    p = tmp_path / "vocab.txt"
+    tok.save(str(p))
+    tok2 = WordPieceTokenizer.from_file(str(p))
+    assert tok2.vocab == tok.vocab
+
+
+def test_bucket_length():
+    assert bucket_length(1) == 16
+    assert bucket_length(16) == 16
+    assert bucket_length(17) == 32
+    assert bucket_length(10_000) == 2048  # clamps to top bucket
+
+
+def test_pad_batch_static_shapes():
+    seqs = [[5, 6, 7], list(range(20))]
+    ids, mask = pad_batch(seqs)
+    assert ids.shape == (2, 32)  # longest is 20 → bucket 32
+    assert mask.sum() == 23
+    assert ids.dtype == np.int32
+    assert (ids[0, 3:] == PAD_ID).all()
+
+
+def test_pad_batch_batch_buckets():
+    ids, mask = pad_batch([[1, 2]] * 3, batch_buckets=(4, 8))
+    assert ids.shape == (4, 16)
+    assert mask[3].sum() == 0  # appended all-pad row
