@@ -75,6 +75,12 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     t0 = time.perf_counter()
     if not isinstance(payload, dict):
         return bad_input("payload must be a dict")
+    # Validate the threshold before any early return so a malformed payload is
+    # rejected consistently, not only when the device path would consult it.
+    threshold = payload.get("device_threshold", DEVICE_THRESHOLD)
+    if isinstance(threshold, bool) or not isinstance(threshold, (int, float)) or threshold <= 0:
+        return bad_input("device_threshold must be a positive number")
+
     try:
         values = _extract_values(payload)
     except ValueError as exc:
@@ -85,7 +91,7 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     use_device = (
         ctx is not None
         and getattr(ctx, "runtime", None) is not None
-        and len(values) >= payload.get("device_threshold", DEVICE_THRESHOLD)
+        and len(values) >= threshold
     )
     if use_device:
         from agent_tpu.parallel.collectives import mesh_reduce_stats

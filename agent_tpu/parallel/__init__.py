@@ -1,0 +1,15 @@
+"""Parallelism: shardings, collectives, and sequence parallelism.
+
+The reference had exactly one form of parallelism — controller-side task
+sharding over HTTP (SURVEY.md §2.8); its "reduce" was host Python ``sum``/``min``
+/``max`` (reference ``ops/risk_accumulate.py:65-68``) combined controller-side.
+This package supplies the intra-pod tier that did not exist: XLA collectives
+over the mesh's ICI links (``lax.psum``/``pmin``/``pmax`` in
+:mod:`~agent_tpu.parallel.collectives`, ring ``ppermute`` attention in
+:mod:`~agent_tpu.parallel.ring_attention`). The HTTP tier remains the DCN outer
+loop (SURVEY.md §5.8 two-tier design).
+"""
+
+from agent_tpu.parallel.collectives import mesh_reduce_stats
+
+__all__ = ["mesh_reduce_stats"]

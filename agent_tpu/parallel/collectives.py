@@ -1,0 +1,83 @@
+"""On-device reductions over the mesh data axis.
+
+``mesh_reduce_stats`` is the device path of ``risk_accumulate`` (BASELINE.json
+north star: "risk_accumulate runs as an on-device lax.psum reduction",
+replacing the reference's host-side ``sum``/``min``/``max``, reference
+``ops/risk_accumulate.py:65-68``): values are sharded over ``dp``, each shard
+reduces locally on its chip, and the partials combine over ICI with
+``lax.psum``/``pmin``/``pmax`` inside a ``shard_map``.
+
+Shape discipline: input length is padded up to a power-of-two multiple of the
+dp axis size with a mask, so the executable cache sees a small set of static
+lengths (same bucketing story as ``pad_batch``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _padded_len(n: int, multiple: int) -> int:
+    """Smallest power-of-two bucket ≥ n that is a multiple of ``multiple``."""
+    size = max(multiple, 1)
+    while size < n:
+        size *= 2
+    return size
+
+
+def _build_stats_fn(runtime) -> Any:
+    mesh = runtime.mesh
+
+    def local_stats(x: jax.Array, m: jax.Array):
+        s = lax.psum(jnp.sum(x * m), "dp")
+        mn = lax.pmin(jnp.min(jnp.where(m > 0, x, jnp.inf)), "dp")
+        mx = lax.pmax(jnp.max(jnp.where(m > 0, x, -jnp.inf)), "dp")
+        return s, mn, mx
+
+    fn = jax.shard_map(
+        local_stats,
+        mesh=mesh,
+        in_specs=(P("dp"), P("dp")),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(fn)
+
+
+def mesh_reduce_stats(runtime, values: Sequence[float]) -> Dict[str, Any]:
+    """count/sum/mean/min/max of ``values``, reduced on-device over ``dp``.
+
+    Returns the ``risk_accumulate`` result fields (reference
+    ``ops/risk_accumulate.py:70-77`` shape); the caller adds ``ok``/timing.
+    """
+    n = len(values)
+    if n == 0:
+        return {"count": 0, "sum": 0.0, "mean": 0.0, "min": None, "max": None}
+    dp = runtime.axis_size("dp")
+    size = _padded_len(n, dp)
+    x = np.zeros(size, dtype=np.float32)
+    x[:n] = np.asarray(values, dtype=np.float32)
+    m = np.zeros(size, dtype=np.float32)
+    m[:n] = 1.0
+
+    fn = runtime.compiled(
+        ("mesh_reduce_stats", size, dp), lambda: _build_stats_fn(runtime)
+    )
+    sharding = runtime.sharding("dp")
+    s, mn, mx = fn(jax.device_put(x, sharding), jax.device_put(m, sharding))
+    # count is exact host knowledge (len), not a float32 mask-psum: a mask sum
+    # loses integer exactness past 2^24 elements.
+    total = float(s)
+    return {
+        "count": n,
+        "sum": total,
+        "mean": total / n,
+        "min": float(mn),
+        "max": float(mx),
+    }

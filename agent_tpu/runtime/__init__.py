@@ -1,0 +1,38 @@
+"""Device runtime — the mesh-owning successor of the reference's interpreter
+singleton (reference ``ops/_tpu_runtime.py:34-63``).
+
+The reference's L2 was a process-wide Edge-TPU interpreter cache keyed by model
+path: one native handle, loaded lazily, shared by every op invocation. The
+TPU-native inversion (BASELINE.json north star) is that the *mesh* is the
+execution substrate: this package owns
+
+- platform/backend selection (proof-based, like reference
+  ``worker_sizing.py:203-213`` — we only claim what ``jax.devices()`` shows),
+- :class:`~agent_tpu.runtime.mesh.MeshSpec` / mesh construction over the
+  canonical ``(dp, tp, sp)`` axes,
+- an executable cache keyed by (op, static shape key) — the successor of the
+  interpreter singleton, except a "handle" is now an XLA executable
+  (:mod:`agent_tpu.runtime.executor`),
+- a params store: model weights resident in HBM keyed by model id (the
+  ``TPUHandle`` cache generalized, reference ``_tpu_runtime.py:8-13``),
+- :class:`OpContext`, the optional ``ctx`` every op accepts.
+
+Everything works identically on the CPU backend — ``allow_fallback`` semantics
+(reference ``ops/map_classify_tpu.py:84-90``) are "same program, different
+backend", not a second code path.
+"""
+
+from agent_tpu.runtime.context import OpContext
+from agent_tpu.runtime.executor import ExecutableCache
+from agent_tpu.runtime.mesh import MeshSpec, build_mesh
+from agent_tpu.runtime.runtime import TpuRuntime, get_runtime, reset_runtime
+
+__all__ = [
+    "ExecutableCache",
+    "MeshSpec",
+    "OpContext",
+    "TpuRuntime",
+    "build_mesh",
+    "get_runtime",
+    "reset_runtime",
+]

@@ -1,0 +1,31 @@
+"""OpContext — the optional ``ctx`` argument every op accepts.
+
+The reference's TPU op took an optional ``ctx`` dict it never used (reference
+``ops/map_classify_tpu.py:32,44``). Here the context is the typed channel
+through which the agent loop hands ops the device runtime and config; pure host
+ops ignore it, device ops use ``ctx.runtime`` (falling back to the process
+singleton when run standalone).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from agent_tpu.config import Config
+
+
+@dataclass
+class OpContext:
+    runtime: Optional[object] = None   # TpuRuntime; object to keep import light
+    config: Optional[Config] = None
+    # Free-form per-task annotations (job id, trace tags); ops may add timings.
+    tags: Dict[str, Any] = field(default_factory=dict)
+
+    def require_runtime(self):
+        """The runtime, building the process singleton if none was injected."""
+        if self.runtime is None:
+            from agent_tpu.runtime.runtime import get_runtime
+
+            self.runtime = get_runtime(self.config.device if self.config else None)
+        return self.runtime

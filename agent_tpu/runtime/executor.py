@@ -1,0 +1,76 @@
+"""Compiled-executable cache — the successor of the interpreter singleton.
+
+The reference cached one native interpreter per model path so every task after
+the first skipped model load (reference ``ops/_tpu_runtime.py:8-13,42-43``).
+Under XLA the expensive artifact is the *compiled executable*: a traced +
+compiled jit program for one (op, shape-bucket, dtype, sharding) combination.
+This cache makes compilation a once-per-bucket cost, which is why ops feed it
+bucketed static shapes (``agent_tpu.models.tokenizer.pad_batch``) — the cache
+stays small and stops missing once the buckets are warm.
+
+Keys are caller-built tuples of hashables (op name, shape tuple, dtype string,
+mesh axis sizes). Stats are exported for the metrics channel (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Hashable, Tuple
+
+
+class ExecutableCache:
+    """Thread-safe build-once cache: key → built value (a compiled callable for
+    executables; any expensive device-resident object in general — the runtime
+    also uses it for HBM params, where double-build means double transfer).
+
+    A single lock guards the map; the build itself runs outside the lock so a
+    slow XLA compile does not serialize unrelated ops, with a per-key event so
+    concurrent builders of the same key trigger exactly one build.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cache: Dict[Tuple[Hashable, ...], Any] = {}
+        self._building: Dict[Tuple[Hashable, ...], threading.Event] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(
+        self, key: Tuple[Hashable, ...], build: Callable[[], Any]
+    ) -> Any:
+        while True:
+            with self._lock:
+                fn = self._cache.get(key)
+                if fn is not None:
+                    self.hits += 1
+                    return fn
+                ev = self._building.get(key)
+                if ev is None:
+                    self._building[key] = threading.Event()
+                    self.misses += 1
+                    break
+            ev.wait()  # someone else is compiling this key
+        try:
+            fn = build()
+            with self._lock:
+                self._cache[key] = fn
+            return fn
+        finally:
+            with self._lock:
+                self._building.pop(key).set()
+
+    def evict(self, key: Tuple[Hashable, ...]) -> None:
+        with self._lock:
+            self._cache.pop(key, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"entries": len(self._cache), "hits": self.hits, "misses": self.misses}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cache.clear()

@@ -1,0 +1,191 @@
+"""The device runtime object: owns the mesh, the executable cache, and HBM-
+resident model params.
+
+Successor of reference ``ops/_tpu_runtime.py`` (the Edge-TPU interpreter
+singleton): `get_tpu_handle(model_path)` becomes :meth:`TpuRuntime.get_params`
+(weights live in HBM keyed by model id) + :meth:`TpuRuntime.run` (a cached
+pjit-compiled executable instead of ``interpreter.invoke()``). Detection stays
+proof-based like reference ``worker_sizing.py:203-213``: we claim only the
+platform ``jax.devices()`` actually reports; env vars are hints, never proof.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Hashable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from agent_tpu.config import DeviceConfig
+from agent_tpu.runtime.executor import ExecutableCache
+from agent_tpu.runtime.mesh import build_mesh
+from agent_tpu.utils.logging import log
+
+
+def detect_platform(tpu_disabled: bool = False) -> str:
+    """The platform we can *prove* we have: 'tpu' only if jax.devices() shows
+    TPU devices (and the TPU_DISABLED kill-switch is off); else jax's default
+    backend ('cpu'/'gpu'). Mirrors reference worker_sizing.py:195-213.
+
+    With the kill-switch on we return 'cpu' *without* querying the default
+    backend at all — ``jax.devices()`` would initialize the TPU plugin (HBM
+    prealloc, possible hang on a wedged chip), which is exactly what the
+    switch exists to prevent.
+    """
+    if tpu_disabled:
+        return "cpu"
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 — no backend at all ⇒ cpu fallback
+        return "cpu"
+
+
+class TpuRuntime:
+    """One process-wide runtime: mesh + executable cache + HBM params store.
+
+    Single-owner-of-the-device invariant (SURVEY.md §5.2): exactly one runtime
+    owns the mesh; host threads stage data but never touch device state except
+    through this object.
+    """
+
+    def __init__(
+        self,
+        config: Optional[DeviceConfig] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> None:
+        self.config = config or DeviceConfig()
+        if self.config.compile_cache_dir:
+            # Persistent XLA compile cache: restarts skip recompiles (§5.4).
+            jax.config.update("jax_compilation_cache_dir", self.config.compile_cache_dir)
+        if devices is None:
+            platform = detect_platform(self.config.tpu_disabled)
+            devices = jax.devices(platform)
+        self.devices = list(devices)
+        self.platform = self.devices[0].platform
+        self.mesh: Mesh = build_mesh(self.devices, self.config.mesh_shape)
+        self.cache = ExecutableCache()
+        self._params = ExecutableCache()  # build-once dedup, same as executables
+        self._model_ids: set = set()
+        self._params_lock = threading.Lock()
+        self.compute_dtype = self.config.compute_dtype
+
+    # ---- topology ----
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.devices)
+
+    def axis_size(self, name: str) -> int:
+        return self.mesh.shape.get(name, 1)
+
+    # ---- shardings ----
+
+    def sharding(self, *spec: Any) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def data_sharding(self) -> NamedSharding:
+        """Batch-dim-sharded over dp; trailing dims replicated."""
+        return self.sharding("dp")
+
+    def replicated(self) -> NamedSharding:
+        return self.sharding()
+
+    # ---- params store (TPUHandle cache generalized) ----
+
+    def get_params(self, model_id: str, build: Callable[[], Any]) -> Any:
+        """Weights resident on device, built once per process per model id.
+
+        ``build()`` returns a pytree. Leaves that are already device-committed
+        ``jax.Array``\\ s (a model that sharded its own params over tp) are left
+        exactly as built; only host leaves (numpy) are placed, replicated, on
+        the mesh. Build-once dedup rides the same per-key-event cache as
+        executables, so concurrent first callers trigger exactly one build /
+        one HBM transfer.
+        """
+
+        def place() -> Any:
+            host = build()
+            return jax.tree_util.tree_map(
+                lambda leaf: leaf
+                if isinstance(leaf, jax.Array) and leaf.committed
+                else jax.device_put(leaf, self.replicated()),
+                host,
+            )
+
+        with self._params_lock:
+            self._model_ids.add(model_id)
+        return self._params.get_or_build(("params", model_id), place)
+
+    def evict_params(self, model_id: str) -> None:
+        with self._params_lock:
+            self._model_ids.discard(model_id)
+        self._params.evict(("params", model_id))
+
+    # ---- compiled execution ----
+
+    def compiled(
+        self,
+        key: Tuple[Hashable, ...],
+        build: Callable[[], Callable],
+    ) -> Callable:
+        """Executable for ``key``, compiling at most once (see ExecutableCache)."""
+        return self.cache.get_or_build(key, build)
+
+    def _model_ids_snapshot(self) -> set:
+        with self._params_lock:
+            return set(self._model_ids)
+
+    def put_batch(self, arr: np.ndarray) -> jax.Array:
+        """Host batch → device, batch dim sharded over dp.
+
+        The batch dim must divide the dp axis — callers pad with
+        ``pad_batch(batch_buckets=...)`` so this holds by construction.
+        """
+        return jax.device_put(arr, self.data_sharding())
+
+    def describe(self) -> Dict[str, Any]:
+        """Telemetry snapshot for the lease metrics channel (SURVEY.md §5.5)."""
+        out: Dict[str, Any] = {
+            "platform": self.platform,
+            "n_devices": self.n_devices,
+            "mesh": dict(self.mesh.shape),
+            "compute_dtype": self.compute_dtype,
+            "executable_cache": self.cache.stats(),
+            "models_resident": sorted(self._model_ids_snapshot()),
+        }
+        try:
+            mem = self.devices[0].memory_stats()
+            if mem:
+                out["hbm_bytes_in_use"] = int(mem.get("bytes_in_use", 0))
+                out["hbm_bytes_limit"] = int(mem.get("bytes_limit", 0))
+        except Exception:  # noqa: BLE001 — memory_stats unsupported on cpu
+            pass
+        return out
+
+
+# Process-wide singleton, lazily built (reference _tpu_runtime.py:34-43 pattern).
+_runtime: Optional[TpuRuntime] = None
+_runtime_lock = threading.Lock()
+
+
+def get_runtime(config: Optional[DeviceConfig] = None) -> TpuRuntime:
+    global _runtime
+    with _runtime_lock:
+        if _runtime is None:
+            _runtime = TpuRuntime(config)
+            log(
+                "runtime up",
+                platform=_runtime.platform,
+                devices=_runtime.n_devices,
+                mesh=dict(_runtime.mesh.shape),
+            )
+        return _runtime
+
+
+def reset_runtime() -> None:
+    """Tests only: drop the singleton so the next get_runtime rebuilds."""
+    global _runtime
+    with _runtime_lock:
+        _runtime = None

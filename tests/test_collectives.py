@@ -1,0 +1,59 @@
+"""Collectives: device psum reduction must agree with the host path."""
+
+import math
+
+import numpy as np
+import pytest
+
+from agent_tpu.config import DeviceConfig
+from agent_tpu.parallel.collectives import _padded_len, mesh_reduce_stats
+from agent_tpu.runtime import TpuRuntime
+
+
+@pytest.fixture(scope="module")
+def rt():
+    return TpuRuntime(DeviceConfig())
+
+
+def test_padded_len_buckets():
+    assert _padded_len(1, 8) == 8
+    assert _padded_len(8, 8) == 8
+    assert _padded_len(9, 8) == 16
+    assert _padded_len(100, 8) == 128
+
+
+def test_mesh_reduce_matches_host(rt):
+    values = [float(i) * 0.5 - 7.0 for i in range(100)]
+    out = mesh_reduce_stats(rt, values)
+    assert out["count"] == 100
+    assert out["sum"] == pytest.approx(math.fsum(values), rel=1e-5)
+    assert out["mean"] == pytest.approx(math.fsum(values) / 100, rel=1e-5)
+    assert out["min"] == pytest.approx(min(values))
+    assert out["max"] == pytest.approx(max(values))
+
+
+def test_mesh_reduce_single_value(rt):
+    out = mesh_reduce_stats(rt, [3.25])
+    assert out == {"count": 1, "sum": 3.25, "mean": 3.25, "min": 3.25, "max": 3.25}
+
+
+def test_mesh_reduce_reuses_executable(rt):
+    before = rt.cache.stats()["misses"]
+    mesh_reduce_stats(rt, list(np.arange(50, dtype=np.float64)))
+    mesh_reduce_stats(rt, list(np.arange(60, dtype=np.float64)))  # same 64-bucket
+    after = rt.cache.stats()
+    assert after["misses"] == before + 1  # one compile for the shared bucket
+
+
+def test_risk_accumulate_device_path_agrees_with_host(rt):
+    from agent_tpu.ops.risk_accumulate import run
+    from agent_tpu.runtime import OpContext
+
+    values = [float(i % 97) for i in range(5000)]
+    host = run({"values": values})
+    dev = run({"values": values}, OpContext(runtime=rt))
+    assert dev["device"] == "mesh"
+    assert dev["count"] == host["count"]
+    assert dev["sum"] == pytest.approx(host["sum"], rel=1e-4)
+    assert dev["min"] == host["min"]
+    assert dev["max"] == host["max"]

@@ -119,3 +119,17 @@ class TestTriggers:
     def test_oracle_validation(self):
         assert oracle({"item": "", "qty": 1})["ok"] is False
         assert oracle({"item": "x", "qty": "many"})["ok"] is False
+
+
+class TestRiskDeviceThreshold:
+    def test_bad_device_threshold_is_soft_error(self):
+        from agent_tpu.ops.risk_accumulate import run as risk
+
+        out = risk({"values": [1.0], "device_threshold": "soon"})
+        assert out["ok"] is False and "device_threshold" in out["error"]
+        assert risk({"values": [1.0], "device_threshold": 0})["ok"] is False
+        assert risk({"values": [1.0], "device_threshold": True})["ok"] is False
+        # Consistent even on paths that never consult it (empty values)...
+        assert risk({"values": [], "device_threshold": "soon"})["ok"] is False
+        # ...and a float threshold is fine (it's only compared against).
+        assert risk({"values": [1.0], "device_threshold": 8192.0})["ok"] is True

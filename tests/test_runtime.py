@@ -1,0 +1,94 @@
+"""Device runtime tests — run on the 8-device virtual CPU mesh (conftest)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from agent_tpu.config import DeviceConfig
+from agent_tpu.runtime import MeshSpec, TpuRuntime, build_mesh
+from agent_tpu.runtime.executor import ExecutableCache
+from agent_tpu.runtime.runtime import detect_platform, get_runtime, reset_runtime
+
+
+def test_virtual_mesh_has_8_devices():
+    assert len(jax.devices()) == 8  # conftest flag took effect
+
+
+def test_meshspec_defaults_all_to_dp():
+    spec = MeshSpec.resolve(8)
+    assert dict(spec.axes) == {"dp": 8, "tp": 1, "sp": 1}
+
+
+def test_meshspec_partial_shape():
+    spec = MeshSpec.resolve(8, {"tp": 2})
+    assert dict(spec.axes) == {"dp": 4, "tp": 2, "sp": 1}
+    spec = MeshSpec.resolve(8, {"tp": 2, "sp": 2})
+    assert dict(spec.axes) == {"dp": 2, "tp": 2, "sp": 2}
+
+
+def test_meshspec_rejects_indivisible():
+    with pytest.raises(ValueError):
+        MeshSpec.resolve(8, {"tp": 3})
+    with pytest.raises(ValueError):
+        MeshSpec.resolve(8, {"dp": 16})
+    with pytest.raises(ValueError):
+        MeshSpec.resolve(8, {"tp": 0})
+
+
+def test_build_mesh_axes():
+    mesh = build_mesh(shape={"dp": 2, "tp": 2, "sp": 2})
+    assert dict(mesh.shape) == {"dp": 2, "tp": 2, "sp": 2}
+
+
+def test_runtime_shards_batch_over_dp():
+    rt = TpuRuntime(DeviceConfig())
+    assert rt.n_devices == 8
+    batch = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    arr = rt.put_batch(batch)
+    assert arr.sharding.spec == jax.sharding.PartitionSpec("dp")
+    # Each of the 8 devices holds 2 of the 16 rows.
+    assert arr.addressable_shards[0].data.shape == (2, 3)
+    np.testing.assert_array_equal(np.asarray(arr), batch)
+
+
+def test_params_store_builds_once():
+    rt = TpuRuntime(DeviceConfig())
+    calls = []
+
+    def build():
+        calls.append(1)
+        return {"w": np.ones((4, 4), dtype=np.float32)}
+
+    p1 = rt.get_params("m", build)
+    p2 = rt.get_params("m", build)
+    assert len(calls) == 1
+    assert p1 is p2
+
+
+def test_executable_cache_counts():
+    cache = ExecutableCache()
+    fn1 = cache.get_or_build(("k", 1), lambda: (lambda x: x + 1))
+    fn2 = cache.get_or_build(("k", 1), lambda: (lambda x: x + 2))
+    assert fn1 is fn2
+    assert cache.stats() == {"entries": 1, "hits": 1, "misses": 1}
+
+
+def test_detect_platform_cpu_here():
+    assert detect_platform() == "cpu"  # conftest forces JAX_PLATFORMS=cpu
+
+
+def test_singleton_reset():
+    reset_runtime()
+    rt1 = get_runtime()
+    assert get_runtime() is rt1
+    reset_runtime()
+    assert get_runtime() is not rt1
+
+
+def test_describe_telemetry_shape():
+    rt = TpuRuntime(DeviceConfig())
+    d = rt.describe()
+    assert d["platform"] == "cpu"
+    assert d["n_devices"] == 8
+    assert d["mesh"] == {"dp": 8, "tp": 1, "sp": 1}
