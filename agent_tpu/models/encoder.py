@@ -1,0 +1,122 @@
+"""Transformer encoder classifier — the model behind ``map_classify_tpu``.
+
+The reference classified with an INT8 TFLite CNN on a Coral Edge TPU, one row
+per ``interpreter.invoke()`` (reference ``ops/map_classify_tpu.py:71-74``,
+``CONTRACT.md:24`` "No batching"). The TPU-native successor is a BERT-class
+token encoder compiled once per shape bucket and run *batched* with the batch
+dim sharded over the mesh ``dp`` axis (SURVEY.md §2.8) — the MXU wants large
+batched matmuls, not row-at-a-time invokes.
+
+Weights are deterministic from the model id (:func:`agent_tpu.models.layers.seed_from`)
+or loaded from an ``.npz`` checkpoint path — the generalization of the
+reference's immutable model artifact at ``/models/model_edgetpu.tflite``
+(reference ``ops/_tpu_runtime.py:23-31``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agent_tpu.models import layers
+from agent_tpu.models.layers import Params
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    """Model hyperparameters. Defaults give a ~7M-param encoder whose dims are
+    multiples of the MXU tile (128) where it matters (d_model, d_ff)."""
+
+    vocab_size: int = 260          # ByteTokenizer vocab (256 bytes + specials)
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 1024
+    max_len: int = 2048            # reference profile max_tokens (app.py:108)
+    n_classes: int = 1000
+    dtype: str = "bfloat16"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def scaled(self, **overrides) -> "EncoderConfig":
+        return replace(self, **overrides)
+
+
+def init_params(cfg: EncoderConfig, model_id: str = "classify-default") -> Params:
+    """Deterministic param pytree for ``model_id`` (same id ⇒ same weights)."""
+    key = layers.seed_from(model_id)
+    ks = jax.random.split(key, cfg.n_layers + 3)
+    params: Params = {
+        "embed": jax.random.normal(
+            ks[0], (cfg.vocab_size, cfg.d_model), dtype=jnp.float32
+        ) * 0.02,
+        "pos": jnp.asarray(layers.sinusoidal_positions(cfg.max_len, cfg.d_model)),
+        "blocks": [
+            layers.init_block(ks[i + 1], cfg.d_model, cfg.n_heads, cfg.d_ff)
+            for i in range(cfg.n_layers)
+        ],
+        "ln_f": layers.init_layer_norm(cfg.d_model),
+        "head": layers.init_dense(ks[-1], cfg.d_model, cfg.n_classes),
+    }
+    return params
+
+
+def load_npz(path: str, cfg: EncoderConfig) -> Params:
+    """Load params from a flat ``.npz`` (keys like ``blocks.0.attn.wq``)."""
+    flat = dict(np.load(path))
+    params = init_params(cfg, model_id=path)
+
+    def assign(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {k: assign(v, f"{prefix}{k}.") for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [assign(v, f"{prefix}{i}.") for i, v in enumerate(tree)]
+        key = prefix[:-1]
+        return jnp.asarray(flat[key]) if key in flat else tree
+
+    return assign(params)
+
+
+def forward(
+    params: Params,
+    ids: jax.Array,      # [B, L] int32 token ids
+    mask: jax.Array,     # [B, L] int32 padding mask (1 = real)
+    cfg: EncoderConfig,
+    attn_fn=layers.dot_product_attention,
+) -> jax.Array:
+    """Logits [B, n_classes] (f32). Mean-pool over real tokens, linear head."""
+    dtype = cfg.compute_dtype
+    L = ids.shape[1]
+    x = params["embed"].astype(dtype)[ids] + params["pos"][:L].astype(dtype)[None]
+    attn_mask = layers.pad_mask_to_attn(mask)
+    for block in params["blocks"]:
+        x = layers.encoder_block(block, x, attn_mask, dtype, attn_fn=attn_fn)
+    x = layers.layer_norm(params["ln_f"], x)
+    denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1).astype(jnp.float32)
+    pooled = (x.astype(jnp.float32) * mask[:, :, None]).sum(axis=1) / denom
+    logits = layers.dense(params["head"], pooled.astype(dtype), dtype)
+    return logits.astype(jnp.float32)
+
+
+def topk_from_logits(logits: np.ndarray, k: int) -> list:
+    """Host-side top-k per row → [{"index", "score"}] sorted desc, softmaxed.
+
+    Mirrors the reference's ``_topk`` over raw scores (reference
+    ``ops/map_classify_tpu.py:15-19``) but reports calibrated probabilities.
+    """
+    k = max(1, min(int(k), logits.shape[-1]))
+    exp = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = exp / exp.sum(axis=-1, keepdims=True)
+    idx = np.argpartition(-probs, k - 1, axis=-1)[..., :k]
+    out = []
+    for r in range(probs.shape[0]):
+        row = [(int(i), float(probs[r, i])) for i in idx[r]]
+        row.sort(key=lambda t: -t[1])
+        out.append([{"index": i, "score": s} for i, s in row])
+    return out

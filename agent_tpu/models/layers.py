@@ -1,0 +1,225 @@
+"""Pure-JAX transformer building blocks shared by every model in the framework.
+
+Design stance (SURVEY.md §7): models are *functions over param pytrees*, not
+classes — the idiomatic JAX shape. Parameters are plain nested dicts of
+``jnp.float32`` arrays; compute casts to the runtime's compute dtype (bf16 on
+TPU — the MXU-native choice) and accumulates softmax/logits in f32.
+
+Determinism: all init goes through :func:`seed_from` + ``jax.random.fold_in``,
+so a model id string fully determines the weights (zero egress — no hub
+downloads, reference ``ops/map_summarize.py:29-30`` pulled from HF instead).
+
+Sharding: these functions are GSPMD-friendly — no data-dependent shapes, heads
+and ffn hidden kept as separate, shardable axes. Explicit tp/sp placement is
+applied by callers (op executors / the train step) via in_shardings and
+``with_sharding_constraint``; the ring-attention sp path lives in
+``agent_tpu.parallel.ring`` and slots in behind :func:`attention`'s interface.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+NEG_INF = -1e9  # additive mask value; finite so bf16 stays NaN-free
+
+
+def seed_from(name: str) -> jax.Array:
+    """A PRNG key fully determined by ``name`` (model id → weights)."""
+    h = hashlib.sha256(name.encode("utf-8")).digest()
+    return jax.random.PRNGKey(int.from_bytes(h[:4], "big"))
+
+
+def _dense_init(key: jax.Array, shape: Tuple[int, ...], fan_in: int) -> jax.Array:
+    scale = 1.0 / np.sqrt(max(1, fan_in))
+    return jax.random.normal(key, shape, dtype=jnp.float32) * scale
+
+
+def init_dense(key: jax.Array, d_in: int, d_out: int) -> Params:
+    return {
+        "w": _dense_init(key, (d_in, d_out), d_in),
+        "b": jnp.zeros((d_out,), dtype=jnp.float32),
+    }
+
+
+def dense(p: Params, x: jax.Array, dtype: Any) -> jax.Array:
+    return jnp.dot(x.astype(dtype), p["w"].astype(dtype)) + p["b"].astype(dtype)
+
+
+def init_layer_norm(d: int) -> Params:
+    return {
+        "scale": jnp.ones((d,), dtype=jnp.float32),
+        "bias": jnp.zeros((d,), dtype=jnp.float32),
+    }
+
+
+def layer_norm(p: Params, x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    # Normalize in f32 regardless of compute dtype: variance in bf16 is lossy.
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def init_attention(key: jax.Array, d_model: int, n_heads: int) -> Params:
+    """QKV/out projections with an explicit head axis (shardable over tp).
+
+    Shapes: wq/wk/wv ``[d_model, n_heads, d_head]``, wo ``[n_heads, d_head,
+    d_model]`` — the head axis stays a named dimension so a tp sharding rule
+    can split it without reshapes.
+    """
+    d_head = d_model // n_heads
+    ks = jax.random.split(key, 4)
+    return {
+        "wq": _dense_init(ks[0], (d_model, n_heads, d_head), d_model),
+        "wk": _dense_init(ks[1], (d_model, n_heads, d_head), d_model),
+        "wv": _dense_init(ks[2], (d_model, n_heads, d_head), d_model),
+        "wo": _dense_init(ks[3], (n_heads, d_head, d_model), d_model),
+    }
+
+
+def dot_product_attention(
+    q: jax.Array,       # [B, H, Lq, D]
+    k: jax.Array,       # [B, H, Lk, D]
+    v: jax.Array,       # [B, H, Lk, D]
+    mask: jax.Array,    # [B, 1|H, Lq|1, Lk] additive-mask source (1 = attend)
+) -> jax.Array:
+    """Masked softmax(QKᵀ)V with f32 softmax accumulation. [B, H, Lq, D]."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / np.sqrt(d)
+    scores = jnp.where(mask > 0, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def attention(
+    p: Params,
+    x_q: jax.Array,                 # [B, Lq, d_model]
+    x_kv: jax.Array,                # [B, Lk, d_model] (== x_q for self-attn)
+    mask: jax.Array,                # [B, 1, Lq|1, Lk] (1 = attend)
+    dtype: Any,
+    cache: Optional[Dict[str, jax.Array]] = None,
+    cache_index: Optional[jax.Array] = None,
+    attn_fn=dot_product_attention,
+) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
+    """Multi-head attention; optional KV cache for autoregressive decode.
+
+    With ``cache`` (arrays ``k``/``v`` of shape [B, H, Lmax, D]) and a scalar
+    ``cache_index``, the new K/V rows are written at ``cache_index`` via
+    ``dynamic_update_slice`` and attention runs over the full cache — the
+    static-shape decode pattern that keeps ``lax.scan`` from retracing
+    (SURVEY.md §7 "hard parts": decode doesn't retrace per step).
+
+    ``attn_fn`` is the inner attention kernel — the sp ring path
+    (``agent_tpu.parallel.ring.ring_attention``) substitutes here.
+    """
+    q = jnp.einsum("bld,dhe->bhle", x_q.astype(dtype), p["wq"].astype(dtype))
+    k = jnp.einsum("bld,dhe->bhle", x_kv.astype(dtype), p["wk"].astype(dtype))
+    v = jnp.einsum("bld,dhe->bhle", x_kv.astype(dtype), p["wv"].astype(dtype))
+
+    if cache is not None:
+        assert cache_index is not None
+        zero = jnp.zeros((), dtype=jnp.int32)
+        k = jax.lax.dynamic_update_slice(
+            cache["k"].astype(dtype), k, (zero, zero, cache_index, zero)
+        )
+        v = jax.lax.dynamic_update_slice(
+            cache["v"].astype(dtype), v, (zero, zero, cache_index, zero)
+        )
+        cache = {"k": k, "v": v}
+
+    out = attn_fn(q, k, v, mask)
+    y = jnp.einsum("bhle,hed->bld", out, p["wo"].astype(dtype))
+    return y, cache
+
+
+def init_ffn(key: jax.Array, d_model: int, d_ff: int) -> Params:
+    k1, k2 = jax.random.split(key)
+    return {"wi": init_dense(k1, d_model, d_ff), "wo": init_dense(k2, d_ff, d_model)}
+
+
+def ffn(p: Params, x: jax.Array, dtype: Any) -> jax.Array:
+    h = jax.nn.gelu(dense(p["wi"], x, dtype))
+    return dense(p["wo"], h, dtype)
+
+
+def init_block(key: jax.Array, d_model: int, n_heads: int, d_ff: int,
+               cross: bool = False) -> Params:
+    ks = jax.random.split(key, 3)
+    p: Params = {
+        "ln1": init_layer_norm(d_model),
+        "attn": init_attention(ks[0], d_model, n_heads),
+        "ln2": init_layer_norm(d_model),
+        "ffn": init_ffn(ks[1], d_model, d_ff),
+    }
+    if cross:
+        p["ln_x"] = init_layer_norm(d_model)
+        p["xattn"] = init_attention(ks[2], d_model, n_heads)
+    return p
+
+
+def encoder_block(
+    p: Params, x: jax.Array, mask: jax.Array, dtype: Any,
+    attn_fn=dot_product_attention,
+) -> jax.Array:
+    """Pre-LN transformer block: x + Attn(LN(x)); x + FFN(LN(x))."""
+    h = layer_norm(p["ln1"], x)
+    a, _ = attention(p["attn"], h, h, mask, dtype, attn_fn=attn_fn)
+    x = x + a
+    h = layer_norm(p["ln2"], x)
+    return x + ffn(p["ffn"], h, dtype)
+
+
+def decoder_block(
+    p: Params,
+    x: jax.Array,                    # [B, Lq, d_model]
+    self_mask: jax.Array,            # [B, 1, Lq|1, Lself]
+    enc_out: jax.Array,              # [B, Lsrc, d_model]
+    enc_mask: jax.Array,             # [B, 1, 1, Lsrc]
+    dtype: Any,
+    cache: Optional[Dict[str, jax.Array]] = None,
+    cache_index: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
+    h = layer_norm(p["ln1"], x)
+    a, cache = attention(
+        p["attn"], h, h, self_mask, dtype, cache=cache, cache_index=cache_index
+    )
+    x = x + a
+    h = layer_norm(p["ln_x"], x)
+    a, _ = attention(p["xattn"], h, enc_out, enc_mask, dtype)
+    x = x + a
+    h = layer_norm(p["ln2"], x)
+    return x + ffn(p["ffn"], h, dtype), cache
+
+
+def sinusoidal_positions(length: int, d_model: int) -> np.ndarray:
+    """Classic fixed sinusoidal position table [length, d_model] (f32)."""
+    pos = np.arange(length)[:, None].astype(np.float64)
+    dim = np.arange(0, d_model, 2)[None, :].astype(np.float64)
+    angle = pos / np.power(10000.0, dim / d_model)
+    table = np.zeros((length, d_model), dtype=np.float32)
+    table[:, 0::2] = np.sin(angle)
+    table[:, 1::2] = np.cos(angle)
+    return table
+
+
+def causal_mask(length: int) -> np.ndarray:
+    """[1, 1, L, L] lower-triangular attend mask."""
+    return np.tril(np.ones((length, length), dtype=np.int32))[None, None]
+
+
+def pad_mask_to_attn(mask: jax.Array) -> jax.Array:
+    """[B, L] padding mask (1 = real token) → [B, 1, 1, L] broadcastable."""
+    return mask[:, None, None, :]
+
+
+def count_params(params: Params) -> int:
+    return int(sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(params)))

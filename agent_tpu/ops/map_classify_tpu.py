@@ -1,0 +1,220 @@
+"""Classification on the TPU mesh — successor of the reference's Edge-TPU op.
+
+Capability parity with reference ``ops/map_classify_tpu.py:31-90`` +
+``CONTRACT.md:1-27``:
+
+- Payload: required input (``input`` flat numeric list — now token ids — or the
+  batched upgrades ``text``/``texts``), optional ``model_path``, ``topk``
+  (default 5), ``allow_fallback`` (default True).
+- Result: ``{op, model_path, topk: [{index, score}], elapsed_ms}`` (ref
+  ``:76-82``); degraded shape ``{fallback: "cpu", reason, topk: []}`` on
+  failure with ``allow_fallback`` (ref ``:22-28, 84-90``).
+- Input-size validation errors raise (→ structured ``failed`` result at the
+  agent) unless fallback is allowed, matching ref ``:58-69``.
+
+The TPU-native inversion: instead of one ``interpreter.invoke()`` per row, rows
+batch into bucketed static shapes (``pad_batch``), the batch dim shards over
+the mesh ``dp`` axis, and a jit-compiled executable is cached per
+(model, batch-bucket, length-bucket) — reference handle-singleton semantics
+(``ops/_tpu_runtime.py:34-63``) generalized to a compiled-op cache.
+
+Degraded mode is *better* than the reference's: the reference's fallback never
+computes (empty topk, ``CONTRACT.md:26`` "fallback handled elsewhere"); ours
+retries the identical JAX program on the CPU backend and only returns the empty
+shape if that fails too — same program, different backend (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from agent_tpu.ops import register_op
+from agent_tpu.utils.errors import bad_input
+
+DEFAULT_TOPK = 5
+DEFAULT_MODEL_ID = "classify-default"
+
+# Lazy module state: config + CPU fallback runtime, built on first use so the
+# op module imports cleanly on hosts without a working jax backend.
+_cpu_runtime = None
+
+
+def _get_cfg(payload: Dict[str, Any]):
+    from agent_tpu.models.encoder import EncoderConfig
+
+    overrides = payload.get("model_config")
+    if isinstance(overrides, dict):
+        allowed = {
+            k: v for k, v in overrides.items()
+            if k in EncoderConfig.__dataclass_fields__
+        }
+        return EncoderConfig(**allowed)
+    return EncoderConfig()
+
+
+def _resolve_model_id(payload: Dict[str, Any]) -> str:
+    mp = payload.get("model_path")
+    if isinstance(mp, str) and mp:
+        return mp
+    import os
+
+    return os.environ.get("TPU_MODEL_PATH") or DEFAULT_MODEL_ID
+
+
+def _build_params(model_id: str, cfg):
+    import os
+
+    from agent_tpu.models import encoder
+
+    if model_id.endswith(".npz") and os.path.exists(model_id):
+        return encoder.load_npz(model_id, cfg)
+    return encoder.init_params(cfg, model_id=model_id)
+
+
+def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List[List[int]], bool]:
+    """Payload → (list of token-id sequences, was_single_input)."""
+    if "input" in payload:
+        raw = payload["input"]
+        if not isinstance(raw, list) or not raw:
+            raise ValueError("input must be a non-empty flat list of ints")
+        ids = []
+        for v in raw:
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError("input values must be numeric")
+            ids.append(int(v) % cfg.vocab_size)
+        return [ids[: cfg.max_len]], True
+    texts = payload.get("texts")
+    if texts is None and "text" in payload:
+        texts = [payload["text"]]
+    if texts is not None:
+        if not isinstance(texts, list) or not texts or not all(
+            isinstance(t, str) for t in texts
+        ):
+            raise ValueError("texts must be a non-empty list of strings")
+        from agent_tpu.models.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        return [tok.encode(t)[: cfg.max_len] for t in texts], "text" in payload
+    raise ValueError("payload requires 'input' (token ids), 'text', or 'texts'")
+
+
+def _batch_buckets(dp: int) -> List[int]:
+    """Batch-size buckets: dp, 2·dp, … so the batch always divides the mesh."""
+    out, b = [], max(1, dp)
+    while b <= 4096:
+        out.append(b)
+        b *= 2
+    return out
+
+
+def _run_on_runtime(runtime, seqs: List[List[int]], model_id: str, cfg) -> np.ndarray:
+    import jax
+
+    from agent_tpu.models import encoder
+    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS, pad_batch
+
+    dp = runtime.axis_size("dp")
+    # Length buckets must not exceed the position table (max_len).
+    buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_len] or [cfg.max_len]
+    ids, mask = pad_batch(seqs, buckets=buckets, batch_buckets=_batch_buckets(dp))
+    B, L = ids.shape
+
+    params = runtime.get_params(
+        f"{model_id}#encoder", lambda: _build_params(model_id, cfg)
+    )
+    fn = runtime.compiled(
+        ("map_classify_tpu", model_id, B, L, cfg.dtype),
+        lambda: jax.jit(lambda p, i, m: encoder.forward(p, i, m, cfg)),
+    )
+    logits = fn(params, runtime.put_batch(ids), runtime.put_batch(mask))
+    return np.asarray(logits)[: len(seqs)]
+
+
+def _get_cpu_runtime():
+    global _cpu_runtime
+    if _cpu_runtime is None:
+        import jax
+
+        from agent_tpu.config import DeviceConfig
+        from agent_tpu.runtime.runtime import TpuRuntime
+
+        _cpu_runtime = TpuRuntime(
+            config=DeviceConfig(tpu_disabled=True), devices=jax.devices("cpu")
+        )
+    return _cpu_runtime
+
+
+@register_op("map_classify_tpu")
+def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    t0 = time.perf_counter()
+    if not isinstance(payload, dict):
+        return bad_input("payload must be a dict")
+
+    topk = payload.get("topk", DEFAULT_TOPK)
+    if isinstance(topk, bool) or not isinstance(topk, int) or topk <= 0:
+        return bad_input("topk must be a positive int")
+    allow_fallback = bool(payload.get("allow_fallback", True))
+    model_id = _resolve_model_id(payload)
+
+    def _fail(reason: str) -> Dict[str, Any]:
+        # Reference degraded shape (ref ops/map_classify_tpu.py:22-28).
+        return {
+            "ok": True,
+            "op": "map_classify_tpu",
+            "model_path": model_id,
+            "fallback": "cpu",
+            "reason": reason[:500],
+            "topk": [],
+            "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
+        }
+
+    try:
+        cfg = _get_cfg(payload)
+        seqs, single = _collect_sequences(payload, cfg)
+    except ValueError as exc:
+        return bad_input(str(exc))
+
+    fallback_reason = None
+    try:
+        if ctx is not None and getattr(ctx, "require_runtime", None):
+            runtime = ctx.require_runtime()
+        else:
+            from agent_tpu.runtime.runtime import get_runtime
+
+            runtime = get_runtime()
+        logits = _run_on_runtime(runtime, seqs, model_id, cfg)
+        device = runtime.platform
+    except Exception as exc:  # noqa: BLE001 — any device failure → fallback path
+        if not allow_fallback:
+            raise
+        try:
+            runtime = _get_cpu_runtime()
+            logits = _run_on_runtime(runtime, seqs, model_id, cfg)
+            device = runtime.platform
+            fallback_reason = f"{type(exc).__name__}: {exc}"
+        except Exception as cpu_exc:  # noqa: BLE001 — truly degraded
+            return _fail(f"{type(exc).__name__}: {exc}; cpu retry: {cpu_exc}")
+
+    from agent_tpu.models.encoder import topk_from_logits
+
+    per_row = topk_from_logits(logits, topk)
+    out: Dict[str, Any] = {
+        "ok": True,
+        "op": "map_classify_tpu",
+        "model_path": model_id,
+        "device": device,
+        "n_rows": len(seqs),
+        "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
+    }
+    if fallback_reason is not None:
+        out["fallback"] = "cpu"
+        out["reason"] = fallback_reason
+    if single:
+        out["topk"] = per_row[0]
+    else:
+        out["topk"] = per_row[0]
+        out["results"] = [{"topk": t} for t in per_row]
+    return out

@@ -1,0 +1,155 @@
+"""Summarization on the mesh — successor of the reference's torch-BART op.
+
+Capability parity with reference ``ops/map_summarize.py:35-68``:
+
+- Payload: required ``text`` (plus the batched upgrade ``texts``), optional
+  ``max_length`` (default 130, ref ``:46``), ``model_path``.
+- Result: ``{ok, summary, device, model}`` (ref ``:61-67``), plus timing.
+- Input truncated at 1024 tokens (ref ``:49``).
+- Lazy once-per-process model init (ref ``:17-33``) — via the runtime's HBM
+  params store instead of a module-global + lock.
+
+The decode itself is ``models.seq2seq.greedy_generate``: one compiled program,
+``lax.scan`` over static steps, KV cache in HBM — replacing the reference's
+host-side ``model.generate`` beam loop (ref ``:52-59``). SUMMARIZE_FORCE_CPU is
+still honored as a kill-switch (ref ``:10``) but defaults off: BASELINE.json's
+north star is zero CPU-side model execution.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from agent_tpu.ops import register_op
+from agent_tpu.utils.errors import bad_input
+
+DEFAULT_MODEL_ID = "summarize-default"
+DEFAULT_MAX_LENGTH = 130
+
+
+def _resolve_model_id(payload: Dict[str, Any]) -> str:
+    mp = payload.get("model_path")
+    if isinstance(mp, str) and mp:
+        return mp
+    return os.environ.get("BART_MODEL") or DEFAULT_MODEL_ID
+
+
+def _get_cfg(payload: Dict[str, Any]):
+    from agent_tpu.models.seq2seq import Seq2SeqConfig
+
+    overrides = payload.get("model_config")
+    if isinstance(overrides, dict):
+        allowed = {
+            k: v for k, v in overrides.items()
+            if k in Seq2SeqConfig.__dataclass_fields__
+        }
+        return Seq2SeqConfig(**allowed)
+    return Seq2SeqConfig()
+
+
+def _build_params(model_id: str, cfg):
+    from agent_tpu.models import seq2seq
+
+    if model_id.endswith(".npz") and os.path.exists(model_id):
+        return seq2seq.load_npz(model_id, cfg)
+    return seq2seq.init_params(cfg, model_id=model_id)
+
+
+def _batch_buckets(dp: int) -> List[int]:
+    out, b = [], max(1, dp)
+    while b <= 1024:
+        out.append(b)
+        b *= 2
+    return out
+
+
+def _generate(runtime, texts: List[str], model_id: str, cfg,
+              max_new: int) -> Tuple[List[str], str]:
+    import jax
+
+    from agent_tpu.models import seq2seq
+    from agent_tpu.models.tokenizer import ByteTokenizer, pad_batch
+
+    tok = ByteTokenizer()
+    seqs = [tok.encode(t, add_bos=True, add_eos=True)[: cfg.max_src_len]
+            for t in texts]
+    dp = runtime.axis_size("dp")
+    # Length buckets must not exceed the position table (max_src_len).
+    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS
+
+    buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_src_len] or [cfg.max_src_len]
+    ids, mask = pad_batch(seqs, buckets=buckets, batch_buckets=_batch_buckets(dp))
+    B, Ls = ids.shape
+
+    params = runtime.get_params(
+        f"{model_id}#seq2seq", lambda: _build_params(model_id, cfg)
+    )
+    fn = runtime.compiled(
+        ("map_summarize", model_id, B, Ls, max_new, cfg.dtype),
+        lambda: jax.jit(
+            lambda p, i, m: seq2seq.greedy_generate(p, i, m, cfg, max_new)
+        ),
+    )
+    toks, _ = fn(params, runtime.put_batch(ids), runtime.put_batch(mask))
+    toks = np.asarray(toks)[: len(texts)]
+    summaries = [tok.decode([t for t in row if t > 0]) for row in toks]
+    return summaries, runtime.platform
+
+
+@register_op("map_summarize")
+def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    t0 = time.perf_counter()
+    if not isinstance(payload, dict):
+        return bad_input("payload must be a dict")
+
+    texts = payload.get("texts")
+    single = texts is None
+    if single:
+        text = payload.get("text")
+        if not isinstance(text, str) or not text:
+            return bad_input("payload requires a non-empty 'text' string")
+        texts = [text]
+    elif not isinstance(texts, list) or not texts or not all(
+        isinstance(t, str) and t for t in texts
+    ):
+        return bad_input("texts must be a non-empty list of non-empty strings")
+
+    max_new = payload.get("max_length", DEFAULT_MAX_LENGTH)
+    if isinstance(max_new, bool) or not isinstance(max_new, int) or max_new <= 0:
+        return bad_input("max_length must be a positive int")
+
+    model_id = _resolve_model_id(payload)
+    cfg = _get_cfg(payload)
+    max_new = min(max_new, cfg.max_tgt_len)
+
+    from agent_tpu.config import env_bool
+
+    if env_bool("SUMMARIZE_FORCE_CPU", False):
+        from agent_tpu.ops.map_classify_tpu import _get_cpu_runtime
+
+        runtime = _get_cpu_runtime()
+    elif ctx is not None and getattr(ctx, "require_runtime", None):
+        runtime = ctx.require_runtime()
+    else:
+        from agent_tpu.runtime.runtime import get_runtime
+
+        runtime = get_runtime()
+
+    summaries, device = _generate(runtime, texts, model_id, cfg, max_new)
+
+    out: Dict[str, Any] = {
+        "ok": True,
+        "device": device,
+        "model": model_id,
+        "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
+    }
+    if single:
+        out["summary"] = summaries[0]
+    else:
+        out["summary"] = summaries[0]
+        out["summaries"] = summaries
+    return out

@@ -1,0 +1,110 @@
+"""map_classify_tpu on the 8-device virtual CPU mesh (SURVEY.md §4.3).
+
+Covers the reference payload contract (reference ``ops/map_classify_tpu.py:31-90``
++ ``CONTRACT.md``): single flat ``input``, topk shape/ordering, degraded
+fallback shape, plus the TPU-native batched upgrade.
+"""
+
+import numpy as np
+import pytest
+
+from agent_tpu.ops import get_op
+from agent_tpu.runtime.context import OpContext
+from agent_tpu.runtime.runtime import get_runtime
+
+
+@pytest.fixture(scope="module")
+def classify():
+    return get_op("map_classify_tpu")
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return OpContext(runtime=get_runtime())
+
+
+def test_single_input_contract(classify, ctx):
+    out = classify({"input": [1, 2, 3, 4, 5], "topk": 3}, ctx)
+    assert out["ok"] is True
+    assert out["op"] == "map_classify_tpu"
+    assert "fallback" not in out
+    assert len(out["topk"]) == 3
+    for entry in out["topk"]:
+        assert set(entry) == {"index", "score"}
+    scores = [e["score"] for e in out["topk"]]
+    assert scores == sorted(scores, reverse=True)
+    assert out["elapsed_ms"] > 0
+
+
+def test_deterministic_same_model_id(classify, ctx):
+    a = classify({"input": [7, 8, 9], "topk": 5}, ctx)
+    b = classify({"input": [7, 8, 9], "topk": 5}, ctx)
+    assert a["topk"] == b["topk"]
+
+
+def test_different_model_id_different_weights(classify, ctx):
+    a = classify({"input": [7, 8, 9], "model_path": "model-a"}, ctx)
+    b = classify({"input": [7, 8, 9], "model_path": "model-b"}, ctx)
+    assert a["topk"] != b["topk"]
+
+
+def test_batched_texts(classify, ctx):
+    texts = [f"row {i} of the dataset" for i in range(13)]
+    out = classify({"texts": texts, "topk": 2}, ctx)
+    assert out["ok"] is True
+    assert out["n_rows"] == 13
+    assert len(out["results"]) == 13
+    for r in out["results"]:
+        assert len(r["topk"]) == 2
+
+
+def test_batch_matches_single(classify, ctx):
+    """Padding rows to the batch bucket must not change per-row results."""
+    single = classify({"text": "hello world"}, ctx)
+    batched = classify({"texts": ["hello world", "another row"]}, ctx)
+    s = {e["index"]: e["score"] for e in single["topk"]}
+    b = {e["index"]: e["score"] for e in batched["results"][0]["topk"]}
+    assert set(s) == set(b)
+    for i in s:
+        assert np.isclose(s[i], b[i], rtol=1e-4)
+
+
+def test_bad_input_soft_errors(classify, ctx):
+    assert classify({"input": []}, ctx)["ok"] is False
+    assert classify({"input": [1, "x"]}, ctx)["ok"] is False
+    assert classify({"topk": 0, "input": [1]}, ctx)["ok"] is False
+    assert classify({}, ctx)["ok"] is False
+    assert classify("not a dict", ctx)["ok"] is False
+
+
+class _BrokenRuntime:
+    def require_runtime(self):
+        raise RuntimeError("device wedged")
+
+
+def test_fallback_retries_on_cpu(classify):
+    """Device failure + allow_fallback → same program on CPU backend, with the
+    reference's fallback/reason markers (ref ops/map_classify_tpu.py:84-90)."""
+    out = classify({"input": [1, 2, 3]}, _BrokenRuntime())
+    assert out["ok"] is True
+    assert out["fallback"] == "cpu"
+    assert "device wedged" in out["reason"]
+    assert len(out["topk"]) == 5  # our fallback actually computes
+
+
+def test_no_fallback_raises(classify):
+    with pytest.raises(RuntimeError):
+        classify({"input": [1, 2, 3], "allow_fallback": False}, _BrokenRuntime())
+
+
+def test_executable_cache_reuse(classify, ctx):
+    """Same shape bucket twice → second call hits the executable cache."""
+    runtime = ctx.runtime
+    before = runtime.cache.stats()
+    classify({"input": [5] * 10, "model_path": "cache-test"}, ctx)
+    mid = runtime.cache.stats()
+    classify({"input": [6] * 11, "model_path": "cache-test"}, ctx)
+    after = runtime.cache.stats()
+    assert mid["misses"] == before["misses"] + 1
+    assert after["misses"] == mid["misses"]
+    assert after["hits"] == mid["hits"] + 1

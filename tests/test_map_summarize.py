@@ -1,0 +1,110 @@
+"""map_summarize: scan-decode seq2seq on the virtual mesh.
+
+VERDICT item 7 acceptance: registry entry real, output deterministic on CPU
+backend, decode does not retrace per step.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from agent_tpu.models import seq2seq
+from agent_tpu.models.tokenizer import pad_batch, ByteTokenizer
+from agent_tpu.ops import get_op
+from agent_tpu.runtime.context import OpContext
+from agent_tpu.runtime.runtime import get_runtime
+
+SMALL = {"d_model": 64, "n_heads": 4, "n_enc_layers": 2, "n_dec_layers": 2,
+         "d_ff": 128, "max_src_len": 64, "max_tgt_len": 32}
+
+
+@pytest.fixture(scope="module")
+def summarize():
+    return get_op("map_summarize")
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return OpContext(runtime=get_runtime())
+
+
+def test_contract_and_determinism(summarize, ctx):
+    payload = {"text": "a long document " * 4, "model_config": SMALL,
+               "max_length": 16}
+    a = summarize(payload, ctx)
+    b = summarize(payload, ctx)
+    assert a["ok"] is True
+    assert isinstance(a["summary"], str)
+    assert a["model"] == "summarize-default"
+    assert a["device"] in ("cpu", "tpu", "gpu")
+    assert a["summary"] == b["summary"]
+
+
+def test_batched(summarize, ctx):
+    out = summarize(
+        {"texts": ["first doc", "second doc", "third doc"],
+         "model_config": SMALL, "max_length": 8},
+        ctx,
+    )
+    assert out["ok"] is True
+    assert len(out["summaries"]) == 3
+    assert out["summary"] == out["summaries"][0]
+
+
+def test_bad_inputs(summarize, ctx):
+    assert summarize({}, ctx)["ok"] is False
+    assert summarize({"text": ""}, ctx)["ok"] is False
+    assert summarize({"texts": []}, ctx)["ok"] is False
+    assert summarize({"text": "x", "max_length": 0}, ctx)["ok"] is False
+
+
+def test_decode_single_trace():
+    """The whole generate (encode + N decode steps) is ONE traced program:
+    tracing the model function runs it exactly once regardless of step count."""
+    cfg = seq2seq.Seq2SeqConfig(**SMALL)
+    params = seq2seq.init_params(cfg, "trace-test")
+    ids, mask = pad_batch([[1, 5, 6, 7, 2]])
+    traces = {"n": 0}
+
+    def fn(p, i, m):
+        traces["n"] += 1
+        return seq2seq.greedy_generate(p, i, m, cfg, 16)
+
+    jitted = jax.jit(fn)
+    toks, _ = jitted(params, ids, mask)
+    toks2, _ = jitted(params, ids, mask)
+    assert traces["n"] == 1  # one trace for 16 decode steps, and no retrace
+    assert toks.shape == (1, 16)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(toks2))
+
+
+def test_incremental_decode_matches_full_attention():
+    """KV-cache decode must equal naive full-sequence decoder attention."""
+    cfg = seq2seq.Seq2SeqConfig(**SMALL, dtype="float32")
+    params = seq2seq.init_params(cfg, "equiv-test")
+    tok = ByteTokenizer()
+    src = tok.encode("check equivalence", add_bos=True, add_eos=True)
+    ids, mask = pad_batch([src])
+    T = 8
+    toks, _ = jax.jit(
+        lambda p, i, m: seq2seq.greedy_generate(p, i, m, cfg, T)
+    )(params, ids, mask)
+    toks = np.asarray(toks)[0]
+
+    # Naive re-decode: feed the full prefix through the step function one
+    # token at a time with a fresh cache each time, checking argmax agreement.
+    import jax.numpy as jnp
+
+    enc_out = seq2seq.encode(params, jnp.asarray(ids), jnp.asarray(mask), cfg)
+    caches = seq2seq._empty_cache(cfg, 1)
+    prev = jnp.asarray([1], dtype=jnp.int32)  # BOS
+    for t in range(T):
+        logits, caches = seq2seq._decode_step(
+            params, prev, jnp.asarray(t, dtype=jnp.int32),
+            enc_out, jnp.asarray(mask), caches, cfg,
+        )
+        nxt = int(jnp.argmax(logits, axis=-1)[0])
+        if toks[t] == 0:  # post-EOS padding
+            break
+        assert nxt == toks[t], f"step {t}: {nxt} != {toks[t]}"
+        prev = jnp.asarray([nxt], dtype=jnp.int32)
