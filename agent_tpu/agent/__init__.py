@@ -1,0 +1,14 @@
+"""Agent runtime — the lease→execute→report control loop.
+
+Successor of reference ``app.py``: identical wire protocol (SURVEY.md §2.9 —
+``POST /v1/leases`` / ``POST /v1/results``, 204-means-idle, ``job_epoch``
+fencing), same env-var config surface, same error/backoff/drain semantics —
+but dispatching through the real op registry (``load_ops``) instead of a
+private inline table, shipping a *dynamic* worker profile from ``sizing``
+instead of a hardcoded dict, and handing ops an ``OpContext`` that carries the
+device runtime so a leased task executes as a batched SPMD program on the mesh.
+"""
+
+from agent_tpu.agent.app import Agent, main
+
+__all__ = ["Agent", "main"]

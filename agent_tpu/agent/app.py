@@ -1,0 +1,331 @@
+"""The agent control loop (successor of reference ``app.py:143-316``).
+
+Wire protocol (the compatibility contract, SURVEY.md §2.9):
+
+- ``POST /v1/leases`` body ``{agent, capabilities: {ops}, max_tasks, timeout_ms,
+  labels, worker_profile, metrics}``; response 204 (or empty tasks) = idle,
+  else ``{lease_id, tasks: [{id|job_id, op, payload, job_epoch}]}``.
+- ``POST /v1/results`` body ``{lease_id, job_id, job_epoch,
+  status: "succeeded"|"failed", result, error}``; the echoed ``job_epoch`` is
+  the fencing token that lets the controller discard stale retries.
+
+Behavioral contract kept from the reference:
+
+- Ops run **inline** on the main thread — "TPU RULE: no fork / no process
+  pool" (reference ``app.py:286``). The device mesh has exactly one owner; a
+  forked child would wedge the TPU runtime. Parallelism lives *inside* the op
+  (batched SPMD over the mesh), not in host processes.
+- status 0 = transport error (reference ``app.py:146-148``); lease errors back
+  off ``error_backoff_sec`` with per-key rate-limited logging; result-post
+  failures are logged but non-fatal; empty lease sleeps ``idle_sleep_sec``.
+- SIGINT/SIGTERM flip a running flag → graceful drain after the in-flight task.
+- Exit code 2 when TASKS resolves to no ops.
+
+New here: per-task phase timings (lease wait / execute / report) embedded in
+the result for tracing (SURVEY.md §5.1), and device telemetry from
+``TpuRuntime.describe()`` shipped in the lease ``metrics`` channel alongside
+host cpu/ram (reference ``app.py:74-83``).
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from agent_tpu.config import Config
+from agent_tpu.ops import OpFn, load_ops
+from agent_tpu.utils.errors import structured_error
+from agent_tpu.utils.logging import RateLimiter, log
+
+STATUS_TRANSPORT_ERROR = 0  # "could not reach the controller at all"
+
+
+def collect_host_metrics() -> Dict[str, Any]:
+    """``{cpu_util: 0..1, ram_mb}`` via psutil; empty when psutil is missing
+    (reference ``app.py:74-83``)."""
+    try:
+        import psutil  # type: ignore
+
+        return {
+            "cpu_util": psutil.cpu_percent(interval=None) / 100.0,
+            "ram_mb": int(psutil.virtual_memory().used / (1024 * 1024)),
+        }
+    except Exception:  # noqa: BLE001 — psutil optional
+        return {}
+
+
+class Agent:
+    """One agent process: leases tasks, executes them on the mesh, reports.
+
+    ``session`` is any object with ``post(url, json=, timeout=) -> response``
+    (a ``requests.Session`` in production, a stub in tests). ``runtime`` is the
+    ``TpuRuntime`` handed to ops via ``OpContext``; left None it is built
+    lazily by the first op that needs the device, so pure-host agents never
+    touch jax.
+    """
+
+    def __init__(
+        self,
+        config: Optional[Config] = None,
+        session: Any = None,
+        runtime: Any = None,
+    ) -> None:
+        self.config = config or Config.from_env()
+        if session is None:
+            import requests
+
+            session = requests.Session()
+        self.session = session
+        self.runtime = runtime
+        self.running = True
+        self.rate = RateLimiter(self.config.agent.error_log_every_sec)
+        # Resolve the full op table at startup — unknown/disabled names fail
+        # fast here, not mid-lease (the intended design the reference's dead
+        # ops_loader.py:8-19 sketched).
+        self.handlers: Dict[str, OpFn] = load_ops(list(self.config.agent.tasks))
+        self._profile: Optional[Dict[str, Any]] = None
+        self.tasks_done = 0
+
+    # ---- controller I/O ----
+
+    def _post_json(self, path: str, body: Dict[str, Any]) -> Tuple[int, Any]:
+        """POST JSON → (status, parsed body). Status 0 = transport error; JSON
+        parse falls back to raw text (reference ``app.py:143-158``)."""
+        url = f"{self.config.agent.controller_url}{path}"
+        try:
+            resp = self.session.post(
+                url, json=body, timeout=self.config.agent.http_timeout_sec
+            )
+        except Exception as exc:  # noqa: BLE001 — any transport failure
+            return STATUS_TRANSPORT_ERROR, repr(exc)
+        if resp.status_code == 204:
+            return 204, None
+        try:
+            return resp.status_code, resp.json()
+        except ValueError:
+            return resp.status_code, getattr(resp, "text", None)
+
+    def worker_profile(self) -> Dict[str, Any]:
+        """Dynamic profile, built once per process (probing is not free)."""
+        if self._profile is None:
+            from agent_tpu.sizing import build_worker_profile
+
+            self._profile = build_worker_profile(self.config)
+        return self._profile
+
+    def _metrics(self) -> Dict[str, Any]:
+        m = collect_host_metrics()
+        if self.runtime is not None:
+            try:
+                m["device"] = self.runtime.describe()
+            except Exception:  # noqa: BLE001 — telemetry must never kill a lease
+                pass
+        return m
+
+    def lease_once(self) -> Optional[Tuple[str, List[Dict[str, Any]]]]:
+        """One ``/v1/leases`` round-trip → ``(lease_id, tasks)`` or None when
+        idle. Raises RuntimeError on transport/protocol errors so the caller
+        applies backoff (reference ``app.py:161-195``)."""
+        a = self.config.agent
+        status, body = self._post_json(
+            "/v1/leases",
+            {
+                "agent": a.agent_name,
+                "capabilities": {"ops": sorted(self.handlers)},
+                "max_tasks": a.max_tasks,
+                "timeout_ms": a.lease_timeout_ms,
+                "labels": a.labels,
+                "worker_profile": self.worker_profile(),
+                "metrics": self._metrics(),
+            },
+        )
+        if status == STATUS_TRANSPORT_ERROR:
+            raise RuntimeError(f"lease transport error: {body}")
+        if status == 204:
+            return None
+        if status != 200 or not isinstance(body, dict):
+            raise RuntimeError(f"lease HTTP {status}: {str(body)[:200]}")
+        tasks = body.get("tasks")
+        lease_id = body.get("lease_id")
+        if not tasks:
+            return None
+        if not isinstance(lease_id, str) or not isinstance(tasks, list):
+            raise RuntimeError(f"malformed lease response: {str(body)[:200]}")
+        return lease_id, tasks
+
+    def post_result(
+        self,
+        lease_id: str,
+        job_id: str,
+        job_epoch: Any,
+        status: str,
+        result: Any = None,
+        error: Any = None,
+    ) -> bool:
+        http_status, body = self._post_json(
+            "/v1/results",
+            {
+                "lease_id": lease_id,
+                "job_id": job_id,
+                "job_epoch": job_epoch,
+                "status": status,
+                "result": result,
+                "error": error,
+            },
+        )
+        if http_status not in (200, 204):
+            self.rate.log(
+                "result", "post failed", status=http_status, body=str(body)[:200]
+            )
+            return False
+        return True
+
+    # ---- task execution ----
+
+    @staticmethod
+    def extract_task(task: Any) -> Tuple[str, str, Dict[str, Any], Any]:
+        """Task dict → ``(job_id, op, payload, job_epoch)``; accepts ``id`` or
+        ``job_id``, strict types (reference ``app.py:221-234``)."""
+        if not isinstance(task, dict):
+            raise ValueError(f"task must be a dict, got {type(task).__name__}")
+        job_id = task.get("id", task.get("job_id"))
+        op = task.get("op")
+        payload = task.get("payload", {})
+        epoch = task.get("job_epoch")
+        if not isinstance(job_id, str) or not job_id:
+            raise ValueError("task missing string id/job_id")
+        if not isinstance(op, str) or not op:
+            raise ValueError("task missing string op")
+        if payload is None:
+            payload = {}
+        if not isinstance(payload, dict):
+            raise ValueError("task payload must be a dict")
+        return job_id, op, payload, epoch
+
+    def _op_context(self, job_id: str):
+        from agent_tpu.runtime.context import OpContext
+
+        return OpContext(
+            runtime=self.runtime, config=self.config, tags={"job_id": job_id}
+        )
+
+    def run_task(self, lease_id: str, task: Any) -> None:
+        """Execute one leased task inline and report its result.
+
+        Any raised exception becomes a ``failed`` result with the structured
+        ``{type, message, trace}`` error (reference ``app.py:288-294``); the
+        agent itself never dies on an op error.
+        """
+        t0 = time.perf_counter()
+        try:
+            job_id, op, payload, epoch = self.extract_task(task)
+        except ValueError as exc:
+            self.rate.log("task:bad", "malformed task", error=str(exc))
+            # Without a job_id there is nothing to report against; drop it.
+            job_id = task.get("id") if isinstance(task, dict) else None
+            if isinstance(job_id, str) and job_id:
+                self.post_result(
+                    lease_id, job_id, None, "failed", error=structured_error(exc)
+                )
+            return
+
+        fn = self.handlers.get(op)
+        if fn is None:
+            self.post_result(
+                lease_id,
+                job_id,
+                epoch,
+                "failed",
+                error={
+                    "type": "UnknownOp",
+                    "message": f"op {op!r} not in capabilities {sorted(self.handlers)}",
+                    "trace": "",
+                },
+            )
+            return
+
+        ctx = self._op_context(job_id)
+        try:
+            result = fn(payload, ctx)
+            status = "succeeded"
+            error = None
+        except Exception as exc:  # noqa: BLE001 — every op error → failed result
+            result = None
+            status = "failed"
+            error = structured_error(exc)
+            self.rate.log("exec", "op raised", op=op, type=type(exc).__name__)
+        duration_ms = (time.perf_counter() - t0) * 1000.0
+        if isinstance(result, dict):
+            result.setdefault("duration_ms", duration_ms)
+            if ctx.tags.get("timings"):
+                result.setdefault("timings", ctx.tags["timings"])
+        self.post_result(lease_id, job_id, epoch, status, result=result, error=error)
+        self.tasks_done += 1
+        log("task done", op=op, job_id=job_id, status=status,
+            duration_ms=round(duration_ms, 3))
+
+    # ---- main loop ----
+
+    def step(self) -> bool:
+        """One loop iteration. Returns True if a task was executed (so callers
+        and tests can drive the loop deterministically)."""
+        try:
+            leased = self.lease_once()
+        except RuntimeError as exc:
+            self.rate.log("lease", str(exc))
+            time.sleep(self.config.agent.error_backoff_sec)
+            return False
+        if leased is None:
+            time.sleep(self.config.agent.idle_sleep_sec)
+            return False
+        lease_id, tasks = leased
+        for task in tasks:
+            if not self.running:
+                break
+            self.run_task(lease_id, task)
+        return True
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        steps = 0
+        while self.running:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+
+    def shutdown(self, *_args: Any) -> None:
+        """Signal handler: finish the in-flight task, then exit the loop
+        (reference ``app.py:239-249``)."""
+        self.running = False
+        log("shutdown requested — draining")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    config = Config.from_env()
+    if not config.agent.tasks:
+        print("[agent-tpu] no TASKS configured; refusing to start", flush=True)
+        return 2
+    try:
+        agent = Agent(config)
+    except KeyError as exc:
+        # load_ops raised on an unknown/disabled op name — same startup-fail
+        # semantics as an empty TASKS list.
+        print(f"[agent-tpu] bad TASKS: {exc}", flush=True)
+        return 2
+    signal.signal(signal.SIGINT, agent.shutdown)
+    signal.signal(signal.SIGTERM, agent.shutdown)
+    log(
+        "agent up",
+        agent=config.agent.agent_name,
+        controller=config.agent.controller_url,
+        ops=sorted(agent.handlers),
+    )
+    agent.run()
+    log("agent drained", tasks_done=agent.tasks_done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

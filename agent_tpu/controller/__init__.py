@@ -1,0 +1,22 @@
+"""The controller — the other half of the lease protocol.
+
+The reference ships only the *client* side; the server at CONTROLLER_URL is
+external (SURVEY.md §2.9 infers its contract from reference ``app.py:162-213``).
+A self-contained framework needs both, so this package implements it:
+
+- :class:`~agent_tpu.controller.core.Controller` — pure in-memory scheduler:
+  job queue, capability matching, lease issuance + expiry, ``job_epoch``
+  fencing, result collection, CSV shard splitting, and fault-injection hooks
+  (drop a lease, duplicate a task, re-queue with a bumped epoch) for the
+  failure tests SURVEY.md §5.3 calls for.
+- :class:`~agent_tpu.controller.server.ControllerServer` — a stdlib
+  ``ThreadingHTTPServer`` speaking ``POST /v1/leases`` / ``POST /v1/results``
+  with 204-on-idle, matching the wire contract byte for byte. Doubles as the
+  integration-test fake (SURVEY.md §4.2) and as a real single-process
+  controller for small swarms.
+"""
+
+from agent_tpu.controller.core import Controller, Job
+from agent_tpu.controller.server import ControllerServer
+
+__all__ = ["Controller", "ControllerServer", "Job"]

@@ -1,0 +1,284 @@
+"""Controller scheduling core (protocol per SURVEY.md §2.9, inferred from the
+reference client at ``app.py:162-213``).
+
+Design decisions:
+
+- **Pull-based**: agents long-poll; the controller never initiates contact.
+  A lease hands out up to ``max_tasks`` tasks whose op is in the agent's
+  advertised capabilities.
+- **Lease expiry**: each lease carries a TTL; a sweeper re-queues tasks whose
+  lease expired, bumping ``job_epoch`` so the original agent's late result is
+  fenced off (the reference protocol's whole point, ref ``app.py:201,209``).
+- **Epoch fencing**: a result is accepted only if its ``job_epoch`` matches the
+  job's current epoch; stale results are counted, not applied.
+- **Shard splitting**: ``submit_csv_job`` turns ``(source_uri, total_rows,
+  shard_size)`` into one task per shard addressed ``(start_row, shard_size)``
+  — the reference's data-distribution primitive (ref ``ops/csv_shard.py:9-26``)
+  — and an optional ``reduce_op`` job gated on the shards completing.
+- **Fault injection** (SURVEY.md §5.3): ``inject(...)`` arms one-shot faults —
+  ``drop_lease`` (issue no tasks once), ``duplicate_task`` (hand the same task
+  to two leases), ``stale_epoch`` (bump a job's epoch right after leasing so
+  the result arrives stale).
+
+Everything is in-memory and lock-guarded; the HTTP layer in ``server.py`` is a
+thin adapter over this class, so tests can drive it directly in-process.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+PENDING = "pending"
+LEASED = "leased"
+SUCCEEDED = "succeeded"
+FAILED = "failed"
+
+
+@dataclass
+class Job:
+    job_id: str
+    op: str
+    payload: Dict[str, Any]
+    epoch: int = 0
+    state: str = PENDING
+    result: Any = None
+    error: Any = None
+    lease_id: Optional[str] = None
+    lease_deadline: float = 0.0
+    agent: Optional[str] = None
+    attempts: int = 0
+    # Jobs that must complete before this one becomes leasable (reduce stages).
+    after: Set[str] = field(default_factory=set)
+
+    def to_task(self) -> Dict[str, Any]:
+        return {
+            "id": self.job_id,
+            "op": self.op,
+            "payload": self.payload,
+            "job_epoch": self.epoch,
+        }
+
+
+class Controller:
+    def __init__(
+        self,
+        lease_ttl_sec: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.lease_ttl_sec = lease_ttl_sec
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, Job] = {}
+        self._queue: List[str] = []  # FIFO of pending job ids
+        self._faults: List[str] = []  # one-shot armed faults
+        self.stale_results = 0
+        self.last_metrics: Dict[str, Any] = {}
+        self.last_profile: Dict[str, Any] = {}
+
+    # ---- job submission ----
+
+    def submit(
+        self,
+        op: str,
+        payload: Optional[Dict[str, Any]] = None,
+        job_id: Optional[str] = None,
+        after: Optional[Set[str]] = None,
+    ) -> str:
+        job_id = job_id or f"job-{uuid.uuid4().hex[:12]}"
+        job = Job(job_id=job_id, op=op, payload=payload or {}, after=set(after or ()))
+        with self._lock:
+            if job_id in self._jobs:
+                raise ValueError(f"duplicate job id {job_id!r}")
+            self._jobs[job_id] = job
+            self._queue.append(job_id)
+        return job_id
+
+    def submit_csv_job(
+        self,
+        source_uri: str,
+        total_rows: int,
+        shard_size: int,
+        map_op: str = "read_csv_shard",
+        extra_payload: Optional[Dict[str, Any]] = None,
+        reduce_op: Optional[str] = None,
+        reduce_payload: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[List[str], Optional[str]]:
+        """Split a CSV dataset into shard tasks (+ optional gated reduce job).
+
+        Shards address rows ``[start_row, start_row + shard_size)`` — idempotent
+        re-execution is the resume unit (SURVEY.md §5.4).
+        """
+        if shard_size <= 0:
+            raise ValueError("shard_size must be positive")
+        shard_ids: List[str] = []
+        for i, start in enumerate(range(0, total_rows, shard_size)):
+            payload = dict(extra_payload or {})
+            payload.update(
+                source_uri=source_uri,
+                start_row=start,
+                shard_size=min(shard_size, total_rows - start),
+            )
+            shard_ids.append(self.submit(map_op, payload, job_id=f"shard-{i}-{uuid.uuid4().hex[:8]}"))
+        reduce_id = None
+        if reduce_op is not None:
+            reduce_id = self.submit(
+                reduce_op, dict(reduce_payload or {}), after=set(shard_ids)
+            )
+        return shard_ids, reduce_id
+
+    # ---- fault injection (one-shot, SURVEY.md §5.3) ----
+
+    def inject(self, fault: str) -> None:
+        if fault not in ("drop_lease", "duplicate_task", "stale_epoch"):
+            raise ValueError(f"unknown fault {fault!r}")
+        with self._lock:
+            self._faults.append(fault)
+
+    def _take_fault(self, fault: str) -> bool:
+        # caller holds the lock
+        if fault in self._faults:
+            self._faults.remove(fault)
+            return True
+        return False
+
+    # ---- lease protocol ----
+
+    def _expire_leases_locked(self) -> None:
+        now = self._clock()
+        for job in self._jobs.values():
+            if job.state == LEASED and now >= job.lease_deadline:
+                # Dead agent: re-queue with a bumped epoch so its late result
+                # is discarded on arrival.
+                job.epoch += 1
+                job.state = PENDING
+                job.lease_id = None
+                self._queue.append(job.job_id)
+
+    def _deps_done_locked(self, job: Job) -> bool:
+        return all(
+            self._jobs[d].state == SUCCEEDED
+            for d in job.after
+            if d in self._jobs
+        )
+
+    def lease(
+        self,
+        agent: str,
+        capabilities: Optional[Dict[str, Any]] = None,
+        max_tasks: int = 1,
+        worker_profile: Optional[Dict[str, Any]] = None,
+        metrics: Optional[Dict[str, Any]] = None,
+        **_ignored: Any,
+    ) -> Optional[Dict[str, Any]]:
+        """One lease request → ``{lease_id, tasks}`` or None (HTTP 204)."""
+        ops = set((capabilities or {}).get("ops") or [])
+        with self._lock:
+            if metrics:
+                self.last_metrics = metrics
+            if worker_profile:
+                self.last_profile = worker_profile
+            self._expire_leases_locked()
+            if self._take_fault("drop_lease"):
+                return None
+            duplicate = self._take_fault("duplicate_task")
+            stale = self._take_fault("stale_epoch")
+
+            lease_id = f"lease-{uuid.uuid4().hex[:12]}"
+            deadline = self._clock() + self.lease_ttl_sec
+            tasks: List[Dict[str, Any]] = []
+            remaining: List[str] = []
+            for job_id in self._queue:
+                job = self._jobs[job_id]
+                if (
+                    len(tasks) < max(1, max_tasks)
+                    and job.state == PENDING
+                    and (not ops or job.op in ops)
+                    and self._deps_done_locked(job)
+                ):
+                    job.state = LEASED
+                    job.lease_id = lease_id
+                    job.lease_deadline = deadline
+                    job.agent = agent
+                    job.attempts += 1
+                    tasks.append(job.to_task())
+                    if duplicate:
+                        # Same task handed out twice under one lease: the
+                        # second completion must be idempotent/fenced.
+                        tasks.append(job.to_task())
+                        duplicate = False
+                    if stale:
+                        # Epoch bumps right after leasing → the agent's result
+                        # arrives carrying the old epoch and is discarded.
+                        job.epoch += 1
+                        stale = False
+                else:
+                    remaining.append(job_id)
+            self._queue = remaining
+            if not tasks:
+                return None
+            return {"lease_id": lease_id, "tasks": tasks}
+
+    def report(
+        self,
+        lease_id: str,
+        job_id: str,
+        job_epoch: Any,
+        status: str,
+        result: Any = None,
+        error: Any = None,
+        **_ignored: Any,
+    ) -> Dict[str, Any]:
+        """One result post. Stale epochs are counted and discarded."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return {"accepted": False, "reason": "unknown job"}
+            if job_epoch != job.epoch:
+                self.stale_results += 1
+                return {"accepted": False, "reason": "stale epoch"}
+            if job.state == SUCCEEDED:
+                # Duplicate completion (e.g. duplicate_task fault): first wins.
+                return {"accepted": False, "reason": "already complete"}
+            job.state = SUCCEEDED if status == "succeeded" else FAILED
+            job.result = result
+            job.error = error
+            job.lease_id = lease_id
+            if job.state == FAILED:
+                # Failed jobs are re-queued once more before sticking failed —
+                # transient op errors (device warmup, fallback) get one retry.
+                if job.attempts <= 1:
+                    job.state = PENDING
+                    job.epoch += 1
+                    self._queue.append(job.job_id)
+            return {"accepted": True}
+
+    # ---- introspection (for tests, bench, and a future status endpoint) ----
+
+    def job(self, job_id: str) -> Job:
+        with self._lock:
+            return self._jobs[job_id]
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for job in self._jobs.values():
+                out[job.state] = out.get(job.state, 0) + 1
+            return out
+
+    def drained(self) -> bool:
+        with self._lock:
+            return all(
+                j.state in (SUCCEEDED, FAILED) for j in self._jobs.values()
+            )
+
+    def results(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                j.job_id: j.result
+                for j in self._jobs.values()
+                if j.state == SUCCEEDED
+            }

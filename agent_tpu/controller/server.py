@@ -1,0 +1,121 @@
+"""HTTP adapter for the controller core — the server side of SURVEY.md §2.9.
+
+Speaks exactly the contract the agent client expects (and the reference client
+at ``app.py:143-218`` spoke): JSON bodies, ``POST /v1/leases`` answering 200
+``{lease_id, tasks}`` or 204 when idle, ``POST /v1/results`` answering 200
+``{accepted: ...}``. Stdlib ``ThreadingHTTPServer`` — no framework dependency,
+good enough for a single-process controller and for in-process tests.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from agent_tpu.controller.core import Controller
+
+
+class _Handler(BaseHTTPRequestHandler):
+    controller: Controller  # set by ControllerServer on the class it builds
+
+    def log_message(self, *args: Any) -> None:  # silence per-request stderr spam
+        pass
+
+    def _read_json(self) -> Optional[Dict[str, Any]]:
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            raw = self.rfile.read(length) if length else b"{}"
+            body = json.loads(raw or b"{}")
+        except (ValueError, OSError):
+            return None
+        return body if isinstance(body, dict) else None
+
+    def _send(self, status: int, body: Optional[Dict[str, Any]] = None) -> None:
+        self.send_response(status)
+        if body is None:
+            self.end_headers()
+            return
+        data = json.dumps(body).encode()
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        body = self._read_json()
+        if body is None:
+            self._send(400, {"error": "invalid JSON body"})
+            return
+        if self.path == "/v1/leases":
+            lease = self.controller.lease(
+                agent=str(body.get("agent", "")),
+                capabilities=body.get("capabilities"),
+                max_tasks=int(body.get("max_tasks", 1) or 1),
+                worker_profile=body.get("worker_profile"),
+                metrics=body.get("metrics"),
+            )
+            if lease is None:
+                self._send(204)
+            else:
+                self._send(200, lease)
+        elif self.path == "/v1/results":
+            out = self.controller.report(
+                lease_id=str(body.get("lease_id", "")),
+                job_id=str(body.get("job_id", "")),
+                job_epoch=body.get("job_epoch"),
+                status=str(body.get("status", "")),
+                result=body.get("result"),
+                error=body.get("error"),
+            )
+            self._send(200, out)
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+
+class ControllerServer:
+    """Owns a Controller + an HTTP server on a background thread.
+
+    ``port=0`` binds an ephemeral port; ``url`` reports the bound address —
+    tests point an agent's CONTROLLER_URL at it.
+    """
+
+    def __init__(
+        self,
+        controller: Optional[Controller] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        self.controller = controller or Controller()
+        handler = type("Handler", (_Handler,), {"controller": self.controller})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ControllerServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="controller-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "ControllerServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()

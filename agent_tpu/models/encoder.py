@@ -69,18 +69,7 @@ def init_params(cfg: EncoderConfig, model_id: str = "classify-default") -> Param
 
 def load_npz(path: str, cfg: EncoderConfig) -> Params:
     """Load params from a flat ``.npz`` (keys like ``blocks.0.attn.wq``)."""
-    flat = dict(np.load(path))
-    params = init_params(cfg, model_id=path)
-
-    def assign(tree, prefix=""):
-        if isinstance(tree, dict):
-            return {k: assign(v, f"{prefix}{k}.") for k, v in tree.items()}
-        if isinstance(tree, list):
-            return [assign(v, f"{prefix}{i}.") for i, v in enumerate(tree)]
-        key = prefix[:-1]
-        return jnp.asarray(flat[key]) if key in flat else tree
-
-    return assign(params)
+    return layers.assign_from_npz(init_params(cfg, model_id=path), path)
 
 
 def forward(

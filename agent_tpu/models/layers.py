@@ -223,3 +223,23 @@ def pad_mask_to_attn(mask: jax.Array) -> jax.Array:
 
 def count_params(params: Params) -> int:
     return int(sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(params)))
+
+
+def assign_from_npz(params: Params, path: str) -> Params:
+    """Overlay a flat ``.npz`` checkpoint onto an init'd param pytree.
+
+    Keys are dotted paths (``blocks.0.attn.wq``); leaves absent from the file
+    keep their initialized values, so partial checkpoints compose with
+    deterministic init. Shared by encoder and seq2seq loaders.
+    """
+    flat = dict(np.load(path))
+
+    def assign(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {k: assign(v, f"{prefix}{k}.") for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [assign(v, f"{prefix}{i}.") for i, v in enumerate(tree)]
+        key = prefix[:-1]
+        return jnp.asarray(flat[key]) if key in flat else tree
+
+    return assign(params)

@@ -166,15 +166,4 @@ def greedy_generate(
 
 def load_npz(path: str, cfg: Seq2SeqConfig) -> Params:
     """Load params from a flat ``.npz`` (keys like ``dec.0.xattn.wq``)."""
-    flat = dict(np.load(path))
-    params = init_params(cfg, model_id=path)
-
-    def assign(tree, prefix=""):
-        if isinstance(tree, dict):
-            return {k: assign(v, f"{prefix}{k}.") for k, v in tree.items()}
-        if isinstance(tree, list):
-            return [assign(v, f"{prefix}{i}.") for i, v in enumerate(tree)]
-        key = prefix[:-1]
-        return jnp.asarray(flat[key]) if key in flat else tree
-
-    return assign(params)
+    return layers.assign_from_npz(init_params(cfg, model_id=path), path)

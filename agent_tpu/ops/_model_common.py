@@ -1,0 +1,61 @@
+"""Shared plumbing for the model-backed ops (classify, summarize).
+
+Factored out so the two ops cannot drift: model-id resolution (payload →
+env → default, the precedence of reference ``ops/_tpu_runtime.py:23-31``),
+config-from-payload parsing, **config-aware cache keys** (a payload that
+overrides ``model_config`` must never reuse weights or executables built for
+a different config), batch-size buckets, and chunking for batches that exceed
+the top bucket.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import fields
+from typing import Any, Dict, Iterator, List, Sequence, Tuple
+
+
+def resolve_model_id(payload: Dict[str, Any], env_var: str, default: str) -> str:
+    """payload ``model_path`` → env var → default (ref ``_tpu_runtime.py:23-31``)."""
+    mp = payload.get("model_path")
+    if isinstance(mp, str) and mp:
+        return mp
+    return os.environ.get(env_var) or default
+
+
+def config_from_payload(payload: Dict[str, Any], config_cls):
+    """Build ``config_cls`` applying any recognized ``model_config`` overrides."""
+    overrides = payload.get("model_config")
+    if isinstance(overrides, dict):
+        allowed = {
+            k: v for k, v in overrides.items()
+            if k in config_cls.__dataclass_fields__
+        }
+        return config_cls(**allowed)
+    return config_cls()
+
+
+def cfg_key(cfg) -> Tuple:
+    """Hashable fingerprint of a frozen config dataclass — goes into both the
+    params-store key and the executable-cache key so distinct configs never
+    alias (two payloads with different ``model_config`` must get distinct
+    weights and distinct compiled programs)."""
+    return tuple((f.name, getattr(cfg, f.name)) for f in fields(cfg))
+
+
+def batch_buckets(dp: int, cap: int) -> List[int]:
+    """Batch-size buckets dp, 2·dp, … ≤ cap, so the batch dim always divides
+    the mesh ``dp`` axis and the executable cache stays small."""
+    out, b = [], max(1, dp)
+    while b <= cap:
+        out.append(b)
+        b *= 2
+    return out or [max(1, dp)]
+
+
+def iter_chunks(seqs: Sequence, max_chunk: int) -> Iterator[Sequence]:
+    """Slice an oversize batch into ≤ max_chunk pieces — rows beyond the top
+    batch bucket run as extra device calls instead of overflowing ``pad_batch``
+    (which would allocate fewer rows than sequences and crash)."""
+    for i in range(0, len(seqs), max_chunk):
+        yield seqs[i : i + max_chunk]

@@ -44,24 +44,15 @@ _cpu_runtime = None
 
 def _get_cfg(payload: Dict[str, Any]):
     from agent_tpu.models.encoder import EncoderConfig
+    from agent_tpu.ops._model_common import config_from_payload
 
-    overrides = payload.get("model_config")
-    if isinstance(overrides, dict):
-        allowed = {
-            k: v for k, v in overrides.items()
-            if k in EncoderConfig.__dataclass_fields__
-        }
-        return EncoderConfig(**allowed)
-    return EncoderConfig()
+    return config_from_payload(payload, EncoderConfig)
 
 
 def _resolve_model_id(payload: Dict[str, Any]) -> str:
-    mp = payload.get("model_path")
-    if isinstance(mp, str) and mp:
-        return mp
-    import os
+    from agent_tpu.ops._model_common import resolve_model_id
 
-    return os.environ.get("TPU_MODEL_PATH") or DEFAULT_MODEL_ID
+    return resolve_model_id(payload, "TPU_MODEL_PATH", DEFAULT_MODEL_ID)
 
 
 def _build_params(model_id: str, cfg):
@@ -87,8 +78,10 @@ def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List[List[int]], b
             ids.append(int(v) % cfg.vocab_size)
         return [ids[: cfg.max_len]], True
     texts = payload.get("texts")
+    single = False
     if texts is None and "text" in payload:
         texts = [payload["text"]]
+        single = True  # single iff the row came from 'text'; 'texts' wins
     if texts is not None:
         if not isinstance(texts, list) or not texts or not all(
             isinstance(t, str) for t in texts
@@ -97,17 +90,11 @@ def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List[List[int]], b
         from agent_tpu.models.tokenizer import ByteTokenizer
 
         tok = ByteTokenizer()
-        return [tok.encode(t)[: cfg.max_len] for t in texts], "text" in payload
+        return [tok.encode(t)[: cfg.max_len] for t in texts], single
     raise ValueError("payload requires 'input' (token ids), 'text', or 'texts'")
 
 
-def _batch_buckets(dp: int) -> List[int]:
-    """Batch-size buckets: dp, 2·dp, … so the batch always divides the mesh."""
-    out, b = [], max(1, dp)
-    while b <= 4096:
-        out.append(b)
-        b *= 2
-    return out
+MAX_BATCH = 4096
 
 
 def _run_on_runtime(runtime, seqs: List[List[int]], model_id: str, cfg) -> np.ndarray:
@@ -115,22 +102,29 @@ def _run_on_runtime(runtime, seqs: List[List[int]], model_id: str, cfg) -> np.nd
 
     from agent_tpu.models import encoder
     from agent_tpu.models.tokenizer import DEFAULT_BUCKETS, pad_batch
+    from agent_tpu.ops._model_common import batch_buckets, cfg_key, iter_chunks
 
     dp = runtime.axis_size("dp")
     # Length buckets must not exceed the position table (max_len).
     buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_len] or [cfg.max_len]
-    ids, mask = pad_batch(seqs, buckets=buckets, batch_buckets=_batch_buckets(dp))
-    B, L = ids.shape
+    bbuckets = batch_buckets(dp, MAX_BATCH)
 
     params = runtime.get_params(
-        f"{model_id}#encoder", lambda: _build_params(model_id, cfg)
+        f"{model_id}#encoder#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}",
+        lambda: _build_params(model_id, cfg),
     )
-    fn = runtime.compiled(
-        ("map_classify_tpu", model_id, B, L, cfg.dtype),
-        lambda: jax.jit(lambda p, i, m: encoder.forward(p, i, m, cfg)),
-    )
-    logits = fn(params, runtime.put_batch(ids), runtime.put_batch(mask))
-    return np.asarray(logits)[: len(seqs)]
+    out: List[np.ndarray] = []
+    # Oversize batches run as extra device calls on the top bucket shape.
+    for chunk in iter_chunks(seqs, bbuckets[-1]):
+        ids, mask = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
+        B, L = ids.shape
+        fn = runtime.compiled(
+            ("map_classify_tpu", model_id, B, L, cfg_key(cfg)),
+            lambda: jax.jit(lambda p, i, m: encoder.forward(p, i, m, cfg)),
+        )
+        logits = fn(params, runtime.put_batch(ids), runtime.put_batch(mask))
+        out.append(np.asarray(logits)[: len(chunk)])
+    return np.concatenate(out, axis=0)
 
 
 def _get_cpu_runtime():
@@ -212,9 +206,7 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     if fallback_reason is not None:
         out["fallback"] = "cpu"
         out["reason"] = fallback_reason
-    if single:
-        out["topk"] = per_row[0]
-    else:
-        out["topk"] = per_row[0]
+    out["topk"] = per_row[0]
+    if not single:
         out["results"] = [{"topk": t} for t in per_row]
     return out

@@ -32,23 +32,16 @@ DEFAULT_MAX_LENGTH = 130
 
 
 def _resolve_model_id(payload: Dict[str, Any]) -> str:
-    mp = payload.get("model_path")
-    if isinstance(mp, str) and mp:
-        return mp
-    return os.environ.get("BART_MODEL") or DEFAULT_MODEL_ID
+    from agent_tpu.ops._model_common import resolve_model_id
+
+    return resolve_model_id(payload, "BART_MODEL", DEFAULT_MODEL_ID)
 
 
 def _get_cfg(payload: Dict[str, Any]):
     from agent_tpu.models.seq2seq import Seq2SeqConfig
+    from agent_tpu.ops._model_common import config_from_payload
 
-    overrides = payload.get("model_config")
-    if isinstance(overrides, dict):
-        allowed = {
-            k: v for k, v in overrides.items()
-            if k in Seq2SeqConfig.__dataclass_fields__
-        }
-        return Seq2SeqConfig(**allowed)
-    return Seq2SeqConfig()
+    return config_from_payload(payload, Seq2SeqConfig)
 
 
 def _build_params(model_id: str, cfg):
@@ -59,12 +52,7 @@ def _build_params(model_id: str, cfg):
     return seq2seq.init_params(cfg, model_id=model_id)
 
 
-def _batch_buckets(dp: int) -> List[int]:
-    out, b = [], max(1, dp)
-    while b <= 1024:
-        out.append(b)
-        b *= 2
-    return out
+MAX_BATCH = 1024
 
 
 def _generate(runtime, texts: List[str], model_id: str, cfg,
@@ -72,31 +60,34 @@ def _generate(runtime, texts: List[str], model_id: str, cfg,
     import jax
 
     from agent_tpu.models import seq2seq
-    from agent_tpu.models.tokenizer import ByteTokenizer, pad_batch
+    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS, ByteTokenizer, pad_batch
+    from agent_tpu.ops._model_common import batch_buckets, cfg_key, iter_chunks
 
     tok = ByteTokenizer()
     seqs = [tok.encode(t, add_bos=True, add_eos=True)[: cfg.max_src_len]
             for t in texts]
     dp = runtime.axis_size("dp")
     # Length buckets must not exceed the position table (max_src_len).
-    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS
-
     buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_src_len] or [cfg.max_src_len]
-    ids, mask = pad_batch(seqs, buckets=buckets, batch_buckets=_batch_buckets(dp))
-    B, Ls = ids.shape
+    bbuckets = batch_buckets(dp, MAX_BATCH)
 
     params = runtime.get_params(
-        f"{model_id}#seq2seq", lambda: _build_params(model_id, cfg)
+        f"{model_id}#seq2seq#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}",
+        lambda: _build_params(model_id, cfg),
     )
-    fn = runtime.compiled(
-        ("map_summarize", model_id, B, Ls, max_new, cfg.dtype),
-        lambda: jax.jit(
-            lambda p, i, m: seq2seq.greedy_generate(p, i, m, cfg, max_new)
-        ),
-    )
-    toks, _ = fn(params, runtime.put_batch(ids), runtime.put_batch(mask))
-    toks = np.asarray(toks)[: len(texts)]
-    summaries = [tok.decode([t for t in row if t > 0]) for row in toks]
+    summaries: List[str] = []
+    for chunk in iter_chunks(seqs, bbuckets[-1]):
+        ids, mask = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
+        B, Ls = ids.shape
+        fn = runtime.compiled(
+            ("map_summarize", model_id, B, Ls, max_new, cfg_key(cfg)),
+            lambda: jax.jit(
+                lambda p, i, m: seq2seq.greedy_generate(p, i, m, cfg, max_new)
+            ),
+        )
+        toks, _ = fn(params, runtime.put_batch(ids), runtime.put_batch(mask))
+        toks = np.asarray(toks)[: len(chunk)]
+        summaries.extend(tok.decode([t for t in row if t > 0]) for row in toks)
     return summaries, runtime.platform
 
 
@@ -147,9 +138,7 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         "model": model_id,
         "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
     }
-    if single:
-        out["summary"] = summaries[0]
-    else:
-        out["summary"] = summaries[0]
+    out["summary"] = summaries[0]
+    if not single:
         out["summaries"] = summaries
     return out

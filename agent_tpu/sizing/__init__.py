@@ -1,0 +1,29 @@
+"""Capability detection & worker sizing — successor of reference ``worker_sizing.py``.
+
+Same stance as the reference, different substrate:
+
+- **Proof-based TPU detection** (reference ``worker_sizing.py:203-213``): we claim
+  a TPU only if ``jax.devices()`` actually lists TPU devices. Env vars
+  (JAX_PLATFORM_NAME / TPU_NAME / TPU_TYPE) are recorded as hints, never trusted.
+- CPU sizing reserves cores for the OS and derives an in-flight target from a
+  pipeline factor (reference ``worker_sizing.py:44-124``).
+- GPU detection parses ``nvidia-smi`` and honors ``NVIDIA_VISIBLE_DEVICES=none``
+  (reference ``worker_sizing.py:127-185``).
+- TPU_ONLY mode caps CPU at one worker and zeroes GPU so the controller cannot
+  accidentally schedule host work on a TPU agent (reference ``:233-240``), while
+  keeping cpu/gpu keys in the profile to avoid schema drift (reference ``:224-225``).
+
+The TPU-native upgrade: batch/shard sizing is derived from the **mesh topology**
+(device count, HBM bytes) rather than CPU core count — the profile carries
+``tpu.suggested_batch`` and ``tpu.suggested_shard_rows`` hints the controller can
+use when splitting jobs.
+"""
+
+from agent_tpu.sizing.profile import (
+    build_worker_profile,
+    detect_cpu,
+    detect_gpu,
+    detect_tpu,
+)
+
+__all__ = ["build_worker_profile", "detect_cpu", "detect_gpu", "detect_tpu"]

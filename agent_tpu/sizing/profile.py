@@ -1,0 +1,244 @@
+"""Worker profile assembly (reference ``worker_sizing.py:44-256``, rethought).
+
+Everything here is host-side and side-effect free except the optional probes
+(psutil import, one ``nvidia-smi`` subprocess, one ``jax.devices()`` call). All
+probes degrade to conservative answers when their dependency is missing — the
+agent must boot anywhere, like the reference booting without pycoral
+(reference ``ops/_tpu_runtime.py:45-46``).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from agent_tpu.config import Config, DeviceConfig, SizingConfig, env_bool
+
+# Hard limits advertised to the controller with every lease. The reference
+# hardcoded these in its static profile (reference app.py:108); they are a wire
+# contract so we keep the numbers, but max_tokens now reflects the real model
+# context (long-context ring attention lifts it per-model; this is the default).
+MAX_PAYLOAD_BYTES = 262_144
+MAX_TOKENS = 2_048
+
+
+def _logical_cores() -> int:
+    try:
+        import psutil  # type: ignore
+
+        n = psutil.cpu_count(logical=True)
+        if n:
+            return int(n)
+    except Exception:  # noqa: BLE001 — psutil optional
+        pass
+    return os.cpu_count() or 1
+
+
+def _total_ram_bytes() -> Optional[int]:
+    try:
+        import psutil  # type: ignore
+
+        return int(psutil.virtual_memory().total)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES")
+        page_size = os.sysconf("SC_PAGE_SIZE")
+        return int(pages) * int(page_size)
+    except (ValueError, OSError, AttributeError):
+        return None
+
+
+def detect_cpu(cfg: Optional[SizingConfig] = None) -> Dict[str, Any]:
+    """CPU sizing: reserve cores for the OS, derive worker counts + in-flight
+    target (reference ``worker_sizing.py:44-124``)."""
+    cfg = cfg or SizingConfig()
+    cores = _logical_cores()
+    # Reserve ~25% of cores for the OS, clamped to [floor, cap], never all cores.
+    reserved = min(
+        cfg.cpu_reserved_cores_cap,
+        max(cfg.cpu_reserved_cores_floor, cores // 4),
+    )
+    reserved = min(reserved, max(cores - 1, 0))
+    usable = max(1, cores - reserved)
+
+    target_inflight = max(
+        cfg.cpu_min_workers, int(usable * max(cfg.cpu_pipeline_factor, 0.0))
+    )
+
+    soft_cap = cores * max(cfg.cpu_soft_cap_multiplier, 1)
+    ram = _total_ram_bytes()
+    if ram and cfg.cpu_per_worker_bytes > 0:
+        soft_cap = min(soft_cap, max(1, ram // cfg.cpu_per_worker_bytes))
+
+    out: Dict[str, Any] = {
+        "logical_cores": cores,
+        "reserved_cores": reserved,
+        "usable_cores": usable,
+        "target_inflight": min(target_inflight, soft_cap),
+        "max_cpu_workers": int(soft_cap),
+    }
+    if ram is not None:
+        out["ram_bytes"] = ram
+    return out
+
+
+def _nvidia_devices_allowed() -> bool:
+    """``NVIDIA_VISIBLE_DEVICES=none`` (or ``void``) disables GPU scheduling
+    (reference ``worker_sizing.py:127-136``)."""
+    v = os.environ.get("NVIDIA_VISIBLE_DEVICES")
+    if v is None:
+        return True
+    return v.strip().lower() not in ("none", "void", "")
+
+
+def detect_gpu() -> Dict[str, Any]:
+    """GPU inventory via ``nvidia-smi`` (reference ``worker_sizing.py:139-185``).
+
+    Absent binary, disallowed visibility, or parse failure all mean "no GPU".
+    """
+    none = {"gpu_present": False, "gpus": [], "max_gpu_workers": 0}
+    if not _nvidia_devices_allowed():
+        return none
+    if shutil.which("nvidia-smi") is None:
+        return none
+    try:
+        proc = subprocess.run(
+            [
+                "nvidia-smi",
+                "--query-gpu=name,memory.total",
+                "--format=csv,noheader,nounits",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return none
+    if proc.returncode != 0:
+        return none
+    gpus: List[Dict[str, Any]] = []
+    for line in proc.stdout.splitlines():
+        parts = [p.strip() for p in line.split(",")]
+        if len(parts) < 2 or not parts[0]:
+            continue
+        gpu: Dict[str, Any] = {"name": parts[0]}
+        try:
+            gpu["memory_mb"] = int(float(parts[1]))
+        except (TypeError, ValueError):
+            pass
+        gpus.append(gpu)
+    if not gpus:
+        return none
+    return {"gpu_present": True, "gpus": gpus, "max_gpu_workers": len(gpus)}
+
+
+def detect_tpu(device_cfg: Optional[DeviceConfig] = None) -> Dict[str, Any]:
+    """Proof-based TPU detection (reference ``worker_sizing.py:188-218``).
+
+    A TPU is claimed only when ``jax.devices()`` lists devices whose platform is
+    ``tpu``. Hints from the environment are recorded for observability but never
+    flip ``tpu_present`` by themselves. The TPU_DISABLED kill-switch returns
+    early *without importing jax* — initializing the TPU plugin is exactly what
+    the switch exists to prevent.
+    """
+    device_cfg = device_cfg or DeviceConfig()
+    hints = {
+        k: v
+        for k, v in {
+            "platform_hint": device_cfg.platform_hint,
+            "tpu_name": device_cfg.tpu_name,
+            "tpu_type": device_cfg.tpu_type,
+        }.items()
+        if v
+    }
+    if device_cfg.tpu_disabled:
+        return {
+            "tpu_present": False,
+            "max_tpu_workers": 0,
+            "disabled": True,
+            "hints": hints,
+        }
+    out: Dict[str, Any] = {"tpu_present": False, "max_tpu_workers": 0, "hints": hints}
+    try:
+        import jax
+
+        devices = jax.devices()
+        tpus = [d for d in devices if d.platform == "tpu"]
+        if tpus:
+            out["tpu_present"] = True
+            # One runtime owns the whole mesh (single-owner invariant, SURVEY
+            # §5.2) — so one "worker", however many chips it spans.
+            out["max_tpu_workers"] = 1
+            out["n_chips"] = len(tpus)
+            out["device_kind"] = tpus[0].device_kind
+            try:
+                mem = tpus[0].memory_stats() or {}
+                if mem.get("bytes_limit"):
+                    out["hbm_bytes_per_chip"] = int(mem["bytes_limit"])
+            except Exception:  # noqa: BLE001 — memory_stats optional
+                pass
+        else:
+            out["backend_platform"] = devices[0].platform if devices else None
+    except Exception as exc:  # noqa: BLE001 — no jax / no backend ⇒ no TPU
+        out["probe_error"] = repr(exc)
+    return out
+
+
+def _tpu_batch_hints(tpu: Dict[str, Any]) -> Dict[str, int]:
+    """Topology-derived batching hints — the TPU-native replacement for sizing
+    by CPU core count. The controller uses these when splitting jobs.
+
+    suggested_batch: rows per device step — sized so activation memory stays a
+    small slice of HBM at our default encoder footprint; multiple of chip count
+    so the dp axis always divides the batch.
+    suggested_shard_rows: rows per leased task — enough batches per task that
+    lease-protocol overhead amortizes to noise (SURVEY §3.1).
+    """
+    chips = max(1, int(tpu.get("n_chips", 1)))
+    hbm = int(tpu.get("hbm_bytes_per_chip", 16 * 2**30))
+    # ~1 MB activation budget per row at seq 512 / d_model 512 in bf16, padded
+    # generously; cap the per-chip batch to keep compile shapes reasonable.
+    per_chip = max(8, min(1024, hbm // (64 * 2**20)))
+    batch = per_chip * chips
+    return {"suggested_batch": batch, "suggested_shard_rows": batch * 16}
+
+
+def build_worker_profile(config: Optional[Config] = None) -> Dict[str, Any]:
+    """Assemble the worker profile shipped with every lease request
+    (reference ``worker_sizing.py:221-256`` + the static profile it was meant
+    to replace, reference ``app.py:101-109``)."""
+    config = config or Config()
+    cpu = detect_cpu(config.sizing)
+    gpu = detect_gpu()
+    tpu = detect_tpu(config.device)
+
+    tpu_only = config.device.tpu_only or env_bool("TPU_ONLY", False)
+    if tpu_only:
+        # Keep cpu/gpu keys (schema stability, reference :224-225) but prevent
+        # accidental host-side scheduling (reference :233-240).
+        cpu = dict(cpu, max_cpu_workers=1, target_inflight=1)
+        gpu = dict(gpu, gpu_present=False, gpus=[], max_gpu_workers=0)
+
+    tier = "tpu-pod" if tpu.get("n_chips", 0) > 1 else (
+        "tpu" if tpu["tpu_present"] else "cpu"
+    )
+    profile: Dict[str, Any] = {
+        "schema": "worker_profile/v2",
+        "tier": tier,
+        "cpu": cpu,
+        "gpu": gpu,
+        "tpu": dict(tpu, kind=config.agent.tpu_kind),
+        "max_total_workers": (
+            cpu["max_cpu_workers"] + gpu["max_gpu_workers"] + tpu["max_tpu_workers"]
+        ),
+        "limits": {
+            "max_payload_bytes": MAX_PAYLOAD_BYTES,
+            "max_tokens": MAX_TOKENS,
+        },
+    }
+    if tpu["tpu_present"]:
+        profile["tpu"].update(_tpu_batch_hints(tpu))
+    return profile

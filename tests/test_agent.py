@@ -1,0 +1,185 @@
+"""Agent loop unit tests: protocol shapes, error paths, dispatch through the
+registry — driven via a stub session, no sockets (reference behaviors at
+``app.py:143-316``)."""
+
+import json
+
+import pytest
+
+from agent_tpu.agent.app import Agent, collect_host_metrics
+from agent_tpu.config import AgentConfig, Config
+
+
+class StubResponse:
+    def __init__(self, status_code, body=None):
+        self.status_code = status_code
+        self._body = body
+        self.text = json.dumps(body) if body is not None else ""
+
+    def json(self):
+        if self._body is None:
+            raise ValueError("no body")
+        return self._body
+
+
+class StubSession:
+    """Scripted controller: pops one response per POST, records requests."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.requests = []
+
+    def post(self, url, json=None, timeout=None):
+        self.requests.append((url, json))
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+def fast_config(**agent_kw):
+    agent_kw.setdefault("controller_url", "http://test")
+    agent_kw.setdefault("idle_sleep_sec", 0.0)
+    agent_kw.setdefault("error_backoff_sec", 0.0)
+    agent_kw.setdefault("tasks", ("echo",))
+    return Config(agent=AgentConfig(**agent_kw))
+
+
+def test_lease_request_carries_protocol_fields():
+    session = StubSession([StubResponse(204)])
+    agent = Agent(config=fast_config(agent_name="a1"), session=session)
+    agent._profile = {"tier": "test"}  # skip hardware probing
+    assert agent.lease_once() is None
+    url, body = session.requests[0]
+    assert url.endswith("/v1/leases")
+    assert body["agent"] == "a1"
+    assert body["capabilities"]["ops"] == ["echo"]
+    assert body["max_tasks"] == 1
+    assert body["timeout_ms"] == 3000
+    assert body["worker_profile"] == {"tier": "test"}
+    assert "metrics" in body
+
+
+def test_transport_error_raises_for_backoff():
+    session = StubSession([OSError("connection refused")])
+    agent = Agent(config=fast_config(), session=session)
+    agent._profile = {}
+    with pytest.raises(RuntimeError, match="transport"):
+        agent.lease_once()
+
+
+def test_step_executes_task_and_reports_success():
+    lease = StubResponse(
+        200,
+        {
+            "lease_id": "L1",
+            "tasks": [
+                {"id": "j1", "op": "echo", "payload": {"hello": 1}, "job_epoch": 3}
+            ],
+        },
+    )
+    session = StubSession([lease, StubResponse(200, {"accepted": True})])
+    agent = Agent(config=fast_config(), session=session)
+    agent._profile = {}
+    assert agent.step() is True
+    url, body = session.requests[1]
+    assert url.endswith("/v1/results")
+    assert body["lease_id"] == "L1"
+    assert body["job_id"] == "j1"
+    assert body["job_epoch"] == 3  # epoch echoed for fencing
+    assert body["status"] == "succeeded"
+    assert body["result"]["echo"] == {"hello": 1}
+    assert "duration_ms" in body["result"]
+
+
+def test_op_exception_becomes_structured_failed_result():
+    lease = StubResponse(
+        200,
+        {
+            "lease_id": "L1",
+            "tasks": [{"id": "j1", "op": "boom", "payload": {}, "job_epoch": 0}],
+        },
+    )
+    session = StubSession([lease, StubResponse(200, {})])
+    agent = Agent(config=fast_config(), session=session)
+    agent._profile = {}
+
+    def boom(payload, ctx=None):
+        raise RuntimeError("kaput")
+
+    agent.handlers["boom"] = boom
+    agent.step()
+    _, body = session.requests[1]
+    assert body["status"] == "failed"
+    assert body["error"]["type"] == "RuntimeError"
+    assert body["error"]["message"] == "kaput"
+    assert "trace" in body["error"]
+
+
+def test_unknown_op_reports_failed_not_crash():
+    lease = StubResponse(
+        200,
+        {
+            "lease_id": "L1",
+            "tasks": [{"id": "j1", "op": "no_such", "payload": {}, "job_epoch": 0}],
+        },
+    )
+    session = StubSession([lease, StubResponse(200, {})])
+    agent = Agent(config=fast_config(), session=session)
+    agent._profile = {}
+    agent.step()
+    _, body = session.requests[1]
+    assert body["status"] == "failed"
+    assert body["error"]["type"] == "UnknownOp"
+
+
+def test_extract_task_accepts_id_or_job_id_and_validates():
+    ok = {"id": "a", "op": "echo", "payload": {}, "job_epoch": 1}
+    assert Agent.extract_task(ok)[0] == "a"
+    alt = {"job_id": "b", "op": "echo"}
+    job_id, op, payload, epoch = Agent.extract_task(alt)
+    assert (job_id, op, payload, epoch) == ("b", "echo", {}, None)
+    for bad in [
+        "not a dict",
+        {"op": "echo"},
+        {"id": "a"},
+        {"id": "a", "op": "echo", "payload": []},
+        {"id": 7, "op": "echo"},
+    ]:
+        with pytest.raises(ValueError):
+            Agent.extract_task(bad)
+
+
+def test_shutdown_drains_mid_lease():
+    lease = StubResponse(
+        200,
+        {
+            "lease_id": "L1",
+            "tasks": [
+                {"id": "j1", "op": "echo", "payload": {}, "job_epoch": 0},
+                {"id": "j2", "op": "echo", "payload": {}, "job_epoch": 0},
+            ],
+        },
+    )
+    session = StubSession([lease, StubResponse(200, {})])
+    agent = Agent(config=fast_config(max_tasks=2), session=session)
+    agent._profile = {}
+
+    real_run = agent.run_task
+
+    def run_then_stop(lease_id, task):
+        real_run(lease_id, task)
+        agent.shutdown()
+
+    agent.run_task = run_then_stop
+    agent.run(max_steps=5)
+    # Only the first task ran; second was dropped by the drain and will be
+    # re-leased by the controller after TTL.
+    assert agent.tasks_done == 1
+
+
+def test_host_metrics_shape():
+    m = collect_host_metrics()
+    if m:  # psutil present
+        assert 0.0 <= m["cpu_util"] <= 1.0
+        assert m["ram_mb"] >= 0

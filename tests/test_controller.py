@@ -1,0 +1,132 @@
+"""Controller core: lease issuance, expiry, epoch fencing, shard splitting,
+fault injection (SURVEY.md §2.9, §5.3)."""
+
+import pytest
+
+from agent_tpu.controller.core import Controller
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_lease_respects_capabilities_and_max_tasks():
+    c = Controller()
+    c.submit("echo", {"x": 1})
+    c.submit("map_tokenize", {"text": "hi"})
+    c.submit("echo", {"x": 2})
+
+    lease = c.lease("a1", {"ops": ["echo"]}, max_tasks=5)
+    assert lease is not None
+    assert [t["op"] for t in lease["tasks"]] == ["echo", "echo"]
+
+    # Remaining job needs a different capability.
+    assert c.lease("a1", {"ops": ["echo"]}) is None
+    lease2 = c.lease("a2", {"ops": ["map_tokenize"]})
+    assert len(lease2["tasks"]) == 1
+
+
+def test_report_and_epoch_fencing():
+    c = Controller()
+    jid = c.submit("echo", {})
+    lease = c.lease("a1", {"ops": ["echo"]})
+    task = lease["tasks"][0]
+    # Stale epoch rejected and counted.
+    out = c.report(lease["lease_id"], jid, task["job_epoch"] + 1, "succeeded", {"ok": True})
+    assert out["accepted"] is False
+    assert c.stale_results == 1
+    # Correct epoch accepted.
+    out = c.report(lease["lease_id"], jid, task["job_epoch"], "succeeded", {"ok": True})
+    assert out["accepted"] is True
+    assert c.drained()
+
+
+def test_lease_expiry_requeues_with_bumped_epoch():
+    clock = FakeClock()
+    c = Controller(lease_ttl_sec=30.0, clock=clock)
+    jid = c.submit("echo", {})
+    lease1 = c.lease("a1", {"ops": ["echo"]})
+    epoch0 = lease1["tasks"][0]["job_epoch"]
+
+    clock.t = 31.0  # lease expires
+    lease2 = c.lease("a2", {"ops": ["echo"]})
+    assert lease2 is not None
+    assert lease2["tasks"][0]["job_epoch"] == epoch0 + 1
+
+    # The dead agent's late result is fenced off.
+    out = c.report(lease1["lease_id"], jid, epoch0, "succeeded", {"late": True})
+    assert out["accepted"] is False and out["reason"] == "stale epoch"
+    # The re-leased agent's result lands.
+    out = c.report(lease2["lease_id"], jid, epoch0 + 1, "succeeded", {"ok": True})
+    assert out["accepted"] is True
+
+
+def test_csv_shard_splitting_and_gated_reduce():
+    c = Controller()
+    shard_ids, reduce_id = c.submit_csv_job(
+        "file:///data.csv", total_rows=250, shard_size=100,
+        reduce_op="risk_accumulate",
+    )
+    assert len(shard_ids) == 3
+    # Last shard is the remainder.
+    assert c.job(shard_ids[2]).payload["shard_size"] == 50
+    assert c.job(shard_ids[2]).payload["start_row"] == 200
+
+    # Reduce is gated until all shards succeed.
+    lease = c.lease("a1", {"ops": ["risk_accumulate"]})
+    assert lease is None
+    for sid in shard_ids:
+        lease = c.lease("a1", {"ops": ["read_csv_shard"]})
+        task = lease["tasks"][0]
+        c.report(lease["lease_id"], task["id"], task["job_epoch"], "succeeded", {})
+    lease = c.lease("a1", {"ops": ["risk_accumulate"]})
+    assert lease is not None and lease["tasks"][0]["id"] == reduce_id
+
+
+def test_fault_injection_drop_duplicate_stale():
+    c = Controller()
+    c.submit("echo", {})
+    c.inject("drop_lease")
+    assert c.lease("a1", {"ops": ["echo"]}) is None  # dropped once
+    c.inject("duplicate_task")
+    lease = c.lease("a1", {"ops": ["echo"]})
+    assert len(lease["tasks"]) == 2
+    assert lease["tasks"][0]["id"] == lease["tasks"][1]["id"]
+    t = lease["tasks"][0]
+    assert c.report(lease["lease_id"], t["id"], t["job_epoch"], "succeeded", {})["accepted"]
+    # Second (duplicate) completion does not overwrite the first.
+    out = c.report(lease["lease_id"], t["id"], t["job_epoch"], "succeeded", {"dup": True})
+    assert out["accepted"] is False
+
+    jid = c.submit("echo", {})
+    c.inject("stale_epoch")
+    lease = c.lease("a1", {"ops": ["echo"]})
+    t = lease["tasks"][0]
+    out = c.report(lease["lease_id"], jid, t["job_epoch"], "succeeded", {})
+    assert out["accepted"] is False and out["reason"] == "stale epoch"
+
+
+def test_failed_job_retried_once():
+    c = Controller()
+    jid = c.submit("echo", {})
+    lease = c.lease("a1", {"ops": ["echo"]})
+    t = lease["tasks"][0]
+    c.report(lease["lease_id"], jid, t["job_epoch"], "failed", error={"type": "X"})
+    # Re-queued with bumped epoch for one retry.
+    lease2 = c.lease("a1", {"ops": ["echo"]})
+    assert lease2 is not None
+    t2 = lease2["tasks"][0]
+    assert t2["job_epoch"] == t["job_epoch"] + 1
+    c.report(lease2["lease_id"], jid, t2["job_epoch"], "failed", error={"type": "X"})
+    assert c.job(jid).state == "failed"  # sticks after the retry
+
+
+def test_duplicate_job_id_rejected():
+    c = Controller()
+    c.submit("echo", {}, job_id="j1")
+    with pytest.raises(ValueError):
+        c.submit("echo", {}, job_id="j1")

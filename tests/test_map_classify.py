@@ -108,3 +108,44 @@ def test_executable_cache_reuse(classify, ctx):
     assert mid["misses"] == before["misses"] + 1
     assert after["misses"] == mid["misses"]
     assert after["hits"] == mid["hits"] + 1
+
+
+def test_distinct_model_configs_do_not_alias_cache(classify, ctx):
+    """Config-aware cache keys: a payload overriding model_config must not
+    reuse weights/executables built for a different config."""
+    small = {"d_model": 64, "n_heads": 4, "n_layers": 2, "d_ff": 128,
+             "max_len": 64, "n_classes": 10}
+    tiny = dict(small, n_classes=7)
+    a = classify({"input": [1, 2, 3], "model_config": small, "topk": 50}, ctx)
+    b = classify({"input": [1, 2, 3], "model_config": tiny, "topk": 50}, ctx)
+    assert a["ok"] and b["ok"]
+    assert a.get("fallback") is None and b.get("fallback") is None
+    # topk is capped by n_classes → proves each ran under its own config.
+    assert len(a["topk"]) == 10
+    assert len(b["topk"]) == 7
+
+
+def test_oversize_batch_chunks_instead_of_crashing(classify, ctx, monkeypatch):
+    """Batches beyond the top batch bucket split into extra device calls."""
+    import agent_tpu.ops.map_classify_tpu as mod
+
+    monkeypatch.setattr(mod, "MAX_BATCH", 4)
+    small = {"d_model": 32, "n_heads": 2, "n_layers": 1, "d_ff": 64,
+             "max_len": 32, "n_classes": 5}
+    texts = [f"row {i}" for i in range(11)]  # 11 > 2 chunks of 4 + 3
+    out = classify(
+        {"texts": texts, "model_config": small, "allow_fallback": False}, ctx
+    )
+    assert out["ok"] is True
+    assert out["n_rows"] == 11
+    assert len(out["results"]) == 11
+
+
+def test_texts_wins_over_text_and_returns_all_rows(classify, ctx):
+    small = {"d_model": 32, "n_heads": 2, "n_layers": 1, "d_ff": 64,
+             "max_len": 32, "n_classes": 5}
+    out = classify(
+        {"texts": ["a", "b", "c"], "text": "a", "model_config": small}, ctx
+    )
+    assert out["ok"] is True
+    assert len(out["results"]) == 3  # batch mode: nothing silently dropped

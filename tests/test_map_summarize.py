@@ -79,7 +79,14 @@ def test_decode_single_trace():
 
 
 def test_incremental_decode_matches_full_attention():
-    """KV-cache decode must equal naive full-sequence decoder attention."""
+    """KV-cache decode must equal TRUE full-sequence decoder attention: the
+    reference below reruns the whole prefix through the decoder blocks with a
+    causal mask and NO cache, so a cache-update bug (e.g. a wrong
+    dynamic_update_slice index) cannot cancel out between the two sides."""
+    import jax.numpy as jnp
+
+    from agent_tpu.models import layers
+
     cfg = seq2seq.Seq2SeqConfig(**SMALL, dtype="float32")
     params = seq2seq.init_params(cfg, "equiv-test")
     tok = ByteTokenizer()
@@ -91,20 +98,26 @@ def test_incremental_decode_matches_full_attention():
     )(params, ids, mask)
     toks = np.asarray(toks)[0]
 
-    # Naive re-decode: feed the full prefix through the step function one
-    # token at a time with a fresh cache each time, checking argmax agreement.
-    import jax.numpy as jnp
+    def full_prefix_logits(prefix_ids):
+        """Decoder over the whole prefix, full causal attention, cache-free."""
+        dtype = cfg.compute_dtype
+        L = prefix_ids.shape[1]
+        x = params["embed"].astype(dtype)[prefix_ids] + \
+            params["pos"][:L].astype(dtype)[None]
+        causal = jnp.asarray(layers.causal_mask(L))                  # [1,1,L,L]
+        enc_attn = jnp.asarray(mask)[:, None, None, :]
+        enc_out = seq2seq.encode(params, jnp.asarray(ids), jnp.asarray(mask), cfg)
+        for block in params["dec"]:
+            x, _ = layers.decoder_block(block, x, causal, enc_out, enc_attn, dtype)
+        x = layers.layer_norm(params["ln_dec"], x)
+        logits = jnp.dot(x.astype(dtype), params["embed"].astype(dtype).T)
+        return np.asarray(logits.astype(jnp.float32))                # [1,L,V]
 
-    enc_out = seq2seq.encode(params, jnp.asarray(ids), jnp.asarray(mask), cfg)
-    caches = seq2seq._empty_cache(cfg, 1)
-    prev = jnp.asarray([1], dtype=jnp.int32)  # BOS
+    prefix = [1]  # BOS
     for t in range(T):
-        logits, caches = seq2seq._decode_step(
-            params, prev, jnp.asarray(t, dtype=jnp.int32),
-            enc_out, jnp.asarray(mask), caches, cfg,
-        )
-        nxt = int(jnp.argmax(logits, axis=-1)[0])
+        logits = full_prefix_logits(jnp.asarray([prefix], dtype=jnp.int32))
+        nxt = int(np.argmax(logits[0, -1]))
         if toks[t] == 0:  # post-EOS padding
             break
-        assert nxt == toks[t], f"step {t}: {nxt} != {toks[t]}"
-        prev = jnp.asarray([nxt], dtype=jnp.int32)
+        assert nxt == toks[t], f"step {t}: full-attn {nxt} != cached {toks[t]}"
+        prefix.append(nxt)

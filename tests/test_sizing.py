@@ -1,0 +1,68 @@
+"""Sizing: proof-based detection + profile assembly (ref worker_sizing.py)."""
+
+from agent_tpu.config import Config, DeviceConfig, SizingConfig
+from agent_tpu.sizing import (
+    build_worker_profile,
+    detect_cpu,
+    detect_gpu,
+    detect_tpu,
+)
+
+
+def test_cpu_sizing_reserves_cores_and_caps():
+    out = detect_cpu(SizingConfig())
+    assert out["logical_cores"] >= 1
+    assert 1 <= out["usable_cores"] <= out["logical_cores"]
+    assert out["reserved_cores"] + out["usable_cores"] == out["logical_cores"]
+    assert out["target_inflight"] >= 1
+    assert out["max_cpu_workers"] >= 1
+
+
+def test_cpu_sizing_respects_knobs():
+    out = detect_cpu(SizingConfig(cpu_pipeline_factor=1.0, cpu_min_workers=3))
+    assert out["target_inflight"] >= 3
+
+
+def test_gpu_detection_honors_visible_devices_none(monkeypatch):
+    monkeypatch.setenv("NVIDIA_VISIBLE_DEVICES", "none")
+    out = detect_gpu()
+    assert out == {"gpu_present": False, "gpus": [], "max_gpu_workers": 0}
+
+
+def test_tpu_detection_is_proof_based(monkeypatch):
+    # Hints alone never flip tpu_present (ref worker_sizing.py:199-200).
+    cfg = DeviceConfig(tpu_name="fake-pod", tpu_type="v5e-16")
+    out = detect_tpu(cfg)
+    # Test env pins the cpu backend, so regardless of hints: no TPU claimed.
+    assert out["tpu_present"] is False
+    assert out["hints"] == {"tpu_name": "fake-pod", "tpu_type": "v5e-16"}
+
+
+def test_tpu_disabled_kill_switch_short_circuits():
+    out = detect_tpu(DeviceConfig(tpu_disabled=True))
+    assert out == {
+        "tpu_present": False,
+        "max_tpu_workers": 0,
+        "disabled": True,
+        "hints": {},
+    }
+
+
+def test_profile_assembly_and_limits():
+    prof = build_worker_profile(Config())
+    assert prof["schema"] == "worker_profile/v2"
+    assert prof["tier"] in ("cpu", "tpu", "tpu-pod")
+    assert prof["limits"] == {"max_payload_bytes": 262144, "max_tokens": 2048}
+    assert (
+        prof["max_total_workers"]
+        == prof["cpu"]["max_cpu_workers"]
+        + prof["gpu"]["max_gpu_workers"]
+        + prof["tpu"]["max_tpu_workers"]
+    )
+
+
+def test_tpu_only_mode_caps_host_scheduling():
+    prof = build_worker_profile(Config(device=DeviceConfig(tpu_only=True)))
+    # cpu/gpu keys survive (schema stability) but can't attract work.
+    assert prof["cpu"]["max_cpu_workers"] == 1
+    assert prof["gpu"]["max_gpu_workers"] == 0
