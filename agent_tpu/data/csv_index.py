@@ -28,25 +28,36 @@ _CHUNK = 1 << 20  # 1 MiB scan chunks
 
 
 def _scan_row_offsets_py(path: str) -> np.ndarray:
-    """Pure-Python quote-aware scan → int64 array of row-start offsets."""
-    offsets: List[int] = [0]
-    in_quote = False
+    """Vectorized quote-aware scan → int64 array of row-start offsets.
+
+    Per chunk: numpy finds every quote and newline position at once; the
+    number of quotes *before* each newline (``searchsorted``) plus the
+    carried-in quote parity decides which newlines are row boundaries —
+    a '"' inside a quoted field has odd parity and is skipped. ~2 orders of
+    magnitude faster than a per-byte Python loop (the round-1 bottleneck).
+    """
+    parts: List[np.ndarray] = [np.zeros(1, dtype=np.int64)]
+    quote_parity = 0  # quotes seen so far, mod 2, carried across chunks
     pos = 0
     with open(path, "rb") as f:
         while True:
             chunk = f.read(_CHUNK)
             if not chunk:
                 break
-            for i, b in enumerate(chunk):
-                if b == 0x22:  # '"' — doubled quotes toggle twice, net no-op
-                    in_quote = not in_quote
-                elif b == 0x0A and not in_quote:  # '\n'
-                    offsets.append(pos + i + 1)
+            arr = np.frombuffer(chunk, dtype=np.uint8)
+            q_idx = np.flatnonzero(arr == 0x22)  # '"'
+            n_idx = np.flatnonzero(arr == 0x0A)  # '\n'
+            if n_idx.size:
+                quotes_before = np.searchsorted(q_idx, n_idx, side="left")
+                outside = ((quotes_before + quote_parity) % 2) == 0
+                parts.append(n_idx[outside].astype(np.int64) + pos + 1)
+            quote_parity = (quote_parity + q_idx.size) % 2
             pos += len(chunk)
+    offsets = np.concatenate(parts)
     # Drop a trailing offset pointing at EOF (file ends with newline).
-    if offsets and offsets[-1] >= pos and len(offsets) > 1:
-        offsets.pop()
-    return np.asarray(offsets, dtype=np.int64)
+    if len(offsets) > 1 and offsets[-1] >= pos:
+        offsets = offsets[:-1]
+    return offsets
 
 
 def _scan_row_offsets(path: str) -> np.ndarray:

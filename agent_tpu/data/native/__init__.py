@@ -6,6 +6,6 @@ lazily from ``csv_scan.cpp``. Everything here is best-effort — callers fall
 back to pure Python when the toolchain or the built library is unavailable.
 """
 
-from agent_tpu.data.native.build import scan_row_offsets_native
+from agent_tpu.data.native.build import native_available, scan_row_offsets_native
 
-__all__ = ["scan_row_offsets_native"]
+__all__ = ["native_available", "scan_row_offsets_native"]

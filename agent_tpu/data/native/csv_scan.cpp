@@ -1,0 +1,84 @@
+// Quote-aware CSV row scanner — the native half of agent_tpu.data.csv_index.
+//
+// One streaming pass over the file: record the byte offset after every
+// newline that falls OUTSIDE RFC-4180 double quotes (a doubled "" toggles the
+// state twice, net no-op, so no special case is needed). This is the hot loop
+// that lets shard reads become seek+read; the Python fallback implements the
+// identical semantics (csv_index._scan_row_offsets_py), property-tested for
+// agreement in tests/test_csv_native.py.
+//
+// Built lazily by agent_tpu/data/native/build.py:
+//   g++ -O3 -shared -fPIC csv_scan.cpp -o csv_scan.so
+// and called through ctypes — no pybind11 dependency.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+
+extern "C" {
+
+// Scans `path`; on success mallocs an int64 offsets array (first element 0 =
+// start of row 0), stores it in *out, and returns the element count. Returns
+// -1 when the file cannot be opened. Caller must csv_scan_free(*out).
+int64_t csv_scan_offsets(const char *path, int64_t **out);
+void csv_scan_free(int64_t *p);
+
+}  // extern "C"
+
+namespace {
+constexpr size_t kBufSize = 1 << 20;  // 1 MiB read chunks
+}
+
+int64_t csv_scan_offsets(const char *path, int64_t **out) {
+  FILE *f = std::fopen(path, "rb");
+  if (f == nullptr) return -1;
+
+  size_t cap = 1 << 16;
+  int64_t *offs = static_cast<int64_t *>(std::malloc(cap * sizeof(int64_t)));
+  unsigned char *buf = static_cast<unsigned char *>(std::malloc(kBufSize));
+  if (offs == nullptr || buf == nullptr) {
+    std::free(offs);
+    std::free(buf);
+    std::fclose(f);
+    return -1;
+  }
+
+  size_t n = 0;
+  offs[n++] = 0;
+  int64_t pos = 0;
+  bool in_quote = false;
+
+  size_t got;
+  while ((got = std::fread(buf, 1, kBufSize, f)) > 0) {
+    for (size_t i = 0; i < got; ++i) {
+      const unsigned char b = buf[i];
+      if (b == '"') {
+        in_quote = !in_quote;
+      } else if (b == '\n' && !in_quote) {
+        if (n == cap) {
+          cap *= 2;
+          int64_t *grown =
+              static_cast<int64_t *>(std::realloc(offs, cap * sizeof(int64_t)));
+          if (grown == nullptr) {
+            std::free(offs);
+            std::free(buf);
+            std::fclose(f);
+            return -1;
+          }
+          offs = grown;
+        }
+        offs[n++] = pos + static_cast<int64_t>(i) + 1;
+      }
+    }
+    pos += static_cast<int64_t>(got);
+  }
+
+  std::fclose(f);
+  std::free(buf);
+  // A file ending in '\n' leaves a trailing offset at EOF — not a row start.
+  if (n > 1 && offs[n - 1] >= pos) --n;
+  *out = offs;
+  return static_cast<int64_t>(n);
+}
+
+void csv_scan_free(int64_t *p) { std::free(p); }

@@ -1,0 +1,96 @@
+"""Sharding specs for model pytrees over the canonical ``(dp, tp, sp)`` mesh.
+
+The design recipe (scaling-book style): pick the mesh, annotate params and
+batch with :class:`~jax.sharding.PartitionSpec`, and let XLA insert the
+collectives — no hand-written all-reduces in the model code.
+
+Layout choices for the encoder/seq2seq families:
+
+- Attention projections ``wq/wk/wv`` are ``[d_model, heads, d_head]`` → heads
+  shard over ``tp`` (Megatron-style column parallel); ``wo`` is
+  ``[heads, d_head, d_model]`` → heads over ``tp`` (row parallel), so the
+  block's only cross-chip sum is the output projection's, which XLA emits as
+  one psum over ``tp``.
+- FFN ``wi [d, d_ff]`` shards ``d_ff`` over ``tp`` (column), ``wo [d_ff, d]``
+  shards ``d_ff`` over ``tp`` (row) — same single-psum property.
+- Embedding/vocab tables shard the vocab dim over ``tp`` (output projection is
+  a matmul against the transpose, so logits arrive vocab-sharded and the
+  argmax/softmax runs sharded too).
+- LayerNorm scales/biases and position tables replicate (tiny).
+- Activations: batch over ``dp``, sequence over ``sp`` (ring attention's
+  layout, SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+def _attn_specs() -> Params:
+    return {
+        "wq": P(None, "tp", None),
+        "wk": P(None, "tp", None),
+        "wv": P(None, "tp", None),
+        "wo": P("tp", None, None),
+    }
+
+
+def _dense_specs(col: bool) -> Params:
+    # init_dense produces {"w": [in, out], "b": [out]}.
+    if col:
+        return {"w": P(None, "tp"), "b": P("tp")}
+    return {"w": P("tp", None), "b": P()}
+
+
+def _ln_specs() -> Params:
+    return {"scale": P(), "bias": P()}
+
+
+def _block_specs(cross: bool = False) -> Params:
+    p: Params = {
+        "ln1": _ln_specs(),
+        "attn": _attn_specs(),
+        "ln2": _ln_specs(),
+        "ffn": {"wi": _dense_specs(col=True), "wo": _dense_specs(col=False)},
+    }
+    if cross:
+        p["ln_x"] = _ln_specs()
+        p["xattn"] = _attn_specs()
+    return p
+
+
+def encoder_param_specs(cfg) -> Params:
+    """PartitionSpec pytree matching ``models.encoder.init_params(cfg)``."""
+    return {
+        "embed": P("tp", None),
+        "pos": P(),
+        "blocks": [_block_specs() for _ in range(cfg.n_layers)],
+        "ln_f": _ln_specs(),
+        "head": _dense_specs(col=True),
+    }
+
+
+def seq2seq_param_specs(cfg) -> Params:
+    """PartitionSpec pytree matching ``models.seq2seq.init_params(cfg)``."""
+    return {
+        "embed": P("tp", None),
+        "pos": P(),
+        "enc": [_block_specs() for _ in range(cfg.n_enc_layers)],
+        "dec": [_block_specs(cross=True) for _ in range(cfg.n_dec_layers)],
+        "ln_enc": _ln_specs(),
+        "ln_dec": _ln_specs(),
+    }
+
+
+def batch_spec() -> P:
+    """[B, L] token batches: batch over dp, sequence over sp."""
+    return P("dp", "sp")
+
+
+def label_spec() -> P:
+    """[B] labels: batch over dp."""
+    return P("dp")

@@ -1,0 +1,75 @@
+"""Native C++ CSV scanner vs the vectorized Python scanner: identical offsets
+on quoting edge cases, and both fast enough to feed the device (VERDICT item 9:
+index build ≥200 MB/s)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from agent_tpu.data.csv_index import CsvIndex, _scan_row_offsets_py
+from agent_tpu.data.native import native_available, scan_row_offsets_native
+
+EDGE_CASES = [
+    # (name, content)
+    ("plain", 'a,b,c\n1,2,3\n4,5,6\n'),
+    ("quoted_newline", 'a,b\n1,"x\ny"\n2,z\n'),
+    ("doubled_quotes", 'a,b\n1,"he said ""hi"""\n2,"a""b"\n'),
+    ("quote_spanning_chunks", 'a,b\n1,"' + "x" * 3000 + '\n' + "y" * 3000 + '"\n2,z\n'),
+    ("no_trailing_newline", 'a,b\n1,2\n3,4'),
+    ("empty_rows", 'a,b\n\n\n1,2\n'),
+    ("only_header", 'a,b\n'),
+]
+
+
+@pytest.mark.parametrize("name,content", EDGE_CASES, ids=[c[0] for c in EDGE_CASES])
+def test_python_scanner_offsets(tmp_path, name, content):
+    p = tmp_path / f"{name}.csv"
+    p.write_bytes(content.encode())
+    offsets = _scan_row_offsets_py(str(p))
+    # Invariants: starts at 0, strictly increasing, every offset follows an
+    # unquoted newline.
+    assert offsets[0] == 0
+    assert (np.diff(offsets) > 0).all()
+    data = content.encode()
+    for off in offsets[1:]:
+        assert data[off - 1 : off] == b"\n"
+
+
+@pytest.mark.parametrize("name,content", EDGE_CASES, ids=[c[0] for c in EDGE_CASES])
+def test_native_matches_python(tmp_path, name, content):
+    if not native_available():
+        pytest.skip("no C++ toolchain in this environment")
+    p = tmp_path / f"{name}.csv"
+    p.write_bytes(content.encode())
+    native = scan_row_offsets_native(str(p))
+    py = _scan_row_offsets_py(str(p))
+    np.testing.assert_array_equal(native, py)
+
+
+def test_quoted_newline_rows_roundtrip(tmp_csv):
+    idx = CsvIndex.for_file(tmp_csv)
+    rows = idx.read_dict_rows(24, 2)
+    assert rows[1]["text"] == "line one\nline two"  # row 25 spans a newline
+
+
+def test_index_build_throughput(tmp_path):
+    """The round-1 per-byte loop managed ~20 MB/s; require ≥200 MB/s."""
+    p = tmp_path / "big.csv"
+    with open(p, "w") as f:
+        f.write("id,text,risk\n")
+        for i in range(300_000):
+            f.write(f'{i},"record {i} with a payload of text",{i % 89}\n')
+    size_mb = os.path.getsize(p) / 1e6
+    t0 = time.perf_counter()
+    offsets = _scan_row_offsets_py(str(p))
+    dt = time.perf_counter() - t0
+    assert len(offsets) == 300_001
+    assert size_mb / dt >= 200, f"python scan only {size_mb / dt:.0f} MB/s"
+    if native_available():
+        t0 = time.perf_counter()
+        native = scan_row_offsets_native(str(p))
+        dt_n = time.perf_counter() - t0
+        assert len(native) == 300_001
+        assert size_mb / dt_n >= 200, f"native scan only {size_mb / dt_n:.0f} MB/s"
