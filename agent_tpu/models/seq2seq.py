@@ -74,13 +74,14 @@ def init_params(cfg: Seq2SeqConfig, model_id: str = "summarize-default") -> Para
 
 
 def encode(params: Params, src_ids: jax.Array, src_mask: jax.Array,
-           cfg: Seq2SeqConfig) -> jax.Array:
+           cfg: Seq2SeqConfig,
+           attn_fn=layers.dot_product_attention) -> jax.Array:
     dtype = cfg.compute_dtype
     L = src_ids.shape[1]
     x = params["embed"].astype(dtype)[src_ids] + params["pos"][:L].astype(dtype)[None]
     attn_mask = layers.pad_mask_to_attn(src_mask)
     for block in params["enc"]:
-        x = layers.encoder_block(block, x, attn_mask, dtype)
+        x = layers.encoder_block(block, x, attn_mask, dtype, attn_fn=attn_fn)
     return layers.layer_norm(params["ln_enc"], x)
 
 
@@ -133,15 +134,20 @@ def greedy_generate(
     src_mask: jax.Array,   # [B, Ls] int32
     cfg: Seq2SeqConfig,
     max_new_tokens: int,
+    attn_fn=layers.dot_product_attention,
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy decode under one jit trace: ``lax.scan`` over static steps.
 
     Returns (tokens [B, max_new_tokens], lengths [B]) — generation stops
     contributing after EOS per row (tokens after EOS are PAD), but the scan
     always runs the static step count so the executable is shape-stable.
+
+    ``attn_fn`` applies to the *encoder* (where the long context lives — the
+    ring/sp path, SURVEY.md §5.7); decode steps query one position against the
+    KV cache, where sequence sharding buys nothing.
     """
     B = src_ids.shape[0]
-    enc_out = encode(params, src_ids, src_mask, cfg)
+    enc_out = encode(params, src_ids, src_mask, cfg, attn_fn=attn_fn)
     caches = _empty_cache(cfg, B)
     bos = jnp.full((B,), BOS_ID, dtype=jnp.int32)
     done0 = jnp.zeros((B,), dtype=jnp.bool_)

@@ -114,13 +114,16 @@ def _run_on_runtime(runtime, seqs: List[List[int]], model_id: str, cfg) -> np.nd
         lambda: _build_params(model_id, cfg),
     )
     out: List[np.ndarray] = []
+    attn_fn = runtime.attention_fn()  # ring over sp when the mesh has one
     # Oversize batches run as extra device calls on the top bucket shape.
     for chunk in iter_chunks(seqs, bbuckets[-1]):
         ids, mask = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
         B, L = ids.shape
         fn = runtime.compiled(
             ("map_classify_tpu", model_id, B, L, cfg_key(cfg)),
-            lambda: jax.jit(lambda p, i, m: encoder.forward(p, i, m, cfg)),
+            lambda: jax.jit(
+                lambda p, i, m: encoder.forward(p, i, m, cfg, attn_fn=attn_fn)
+            ),
         )
         logits = fn(params, runtime.put_batch(ids), runtime.put_batch(mask))
         out.append(np.asarray(logits)[: len(chunk)])

@@ -76,13 +76,16 @@ def _generate(runtime, texts: List[str], model_id: str, cfg,
         lambda: _build_params(model_id, cfg),
     )
     summaries: List[str] = []
+    attn_fn = runtime.attention_fn()  # ring over sp for the encoder pass
     for chunk in iter_chunks(seqs, bbuckets[-1]):
         ids, mask = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
         B, Ls = ids.shape
         fn = runtime.compiled(
             ("map_summarize", model_id, B, Ls, max_new, cfg_key(cfg)),
             lambda: jax.jit(
-                lambda p, i, m: seq2seq.greedy_generate(p, i, m, cfg, max_new)
+                lambda p, i, m: seq2seq.greedy_generate(
+                    p, i, m, cfg, max_new, attn_fn=attn_fn
+                )
             ),
         )
         toks, _ = fn(params, runtime.put_batch(ids), runtime.put_batch(mask))

@@ -6,10 +6,11 @@ sharding over HTTP (SURVEY.md §2.8); its "reduce" was host Python ``sum``/``min
 This package supplies the intra-pod tier that did not exist: XLA collectives
 over the mesh's ICI links (``lax.psum``/``pmin``/``pmax`` in
 :mod:`~agent_tpu.parallel.collectives`, ring ``ppermute`` attention in
-:mod:`~agent_tpu.parallel.ring_attention`). The HTTP tier remains the DCN outer
+:mod:`~agent_tpu.parallel.ring`). The HTTP tier remains the DCN outer
 loop (SURVEY.md §5.8 two-tier design).
 """
 
 from agent_tpu.parallel.collectives import mesh_reduce_stats
+from agent_tpu.parallel.ring import make_ring_attention
 
-__all__ = ["mesh_reduce_stats"]
+__all__ = ["mesh_reduce_stats", "make_ring_attention"]

@@ -69,6 +69,7 @@ class TpuRuntime:
         self._params = ExecutableCache()  # build-once dedup, same as executables
         self._model_ids: set = set()
         self._params_lock = threading.Lock()
+        self._attention_fn = None
         self.compute_dtype = self.config.compute_dtype
 
     # ---- topology ----
@@ -88,6 +89,17 @@ class TpuRuntime:
     def data_sharding(self) -> NamedSharding:
         """Batch-dim-sharded over dp; trailing dims replicated."""
         return self.sharding("dp")
+
+    def attention_fn(self):
+        """The attention kernel for this mesh: ring attention over ``sp`` when
+        the mesh has a sequence axis, plain dot-product attention otherwise
+        (see ``agent_tpu.parallel.ring``). Built once per runtime; kept out of
+        the executable cache so its stats keep meaning "compiled programs"."""
+        if self._attention_fn is None:
+            from agent_tpu.parallel.ring import make_ring_attention
+
+            self._attention_fn = make_ring_attention(self.mesh)
+        return self._attention_fn
 
     def replicated(self) -> NamedSharding:
         return self.sharding()

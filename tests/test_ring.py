@@ -1,0 +1,126 @@
+"""Ring attention (sp sequence parallelism) must agree with dense attention.
+
+The ring path (``agent_tpu.parallel.ring``) is a different *schedule* of the
+same math — streaming softmax over ppermute-rotated K/V blocks — so on an
+8-device virtual mesh its output must match ``dot_product_attention`` to
+float32 tolerance, including padded keys, fully-padded rows, and the silent
+dense fallback for incompatible shapes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agent_tpu.config import DeviceConfig
+from agent_tpu.models import encoder, layers
+from agent_tpu.parallel.ring import make_ring_attention
+from agent_tpu.runtime import TpuRuntime
+
+MESH_SHAPE = {"dp": 2, "tp": 2, "sp": 2}
+
+
+@pytest.fixture(scope="module")
+def rt():
+    return TpuRuntime(DeviceConfig(mesh_shape=MESH_SHAPE))
+
+
+def _qkvm(B=4, H=4, L=16, D=8, pad_tail=3, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, H, L, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, L, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, L, D)), dtype=jnp.float32)
+    mask_1d = np.ones((B, L), dtype=np.int32)
+    if pad_tail:
+        mask_1d[:, -pad_tail:] = 0
+    mask = jnp.asarray(mask_1d)[:, None, None, :]
+    return q, k, v, mask
+
+
+def test_ring_matches_dense(rt):
+    ring = make_ring_attention(rt.mesh)
+    q, k, v, mask = _qkvm()
+    got = np.asarray(ring(q, k, v, mask))
+    want = np.asarray(layers.dot_product_attention(q, k, v, mask))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_fully_padded_row_is_zero_not_nan(rt):
+    ring = make_ring_attention(rt.mesh)
+    q, k, v, mask = _qkvm()
+    mask = mask.at[1].set(0)  # row 1: every key masked (all-pad bucket row)
+    got = np.asarray(ring(q, k, v, mask))
+    assert np.isfinite(got).all()
+    np.testing.assert_array_equal(got[1], np.zeros_like(got[1]))
+    # Other rows unaffected.
+    want = np.asarray(layers.dot_product_attention(q, k, v, mask))
+    np.testing.assert_allclose(got[0], want[0], rtol=2e-5, atol=2e-5)
+
+
+def test_ring_under_jit_and_cross_attention_lengths(rt):
+    """Lq != Lk (cross-attention) and jit-wrapped: both must hold."""
+    ring = make_ring_attention(rt.mesh)
+    rng = np.random.default_rng(1)
+    B, H, Lq, Lk, D = 4, 4, 8, 16, 8
+    q = jnp.asarray(rng.normal(size=(B, H, Lq, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, Lk, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, Lk, D)), dtype=jnp.float32)
+    mask = jnp.ones((B, 1, 1, Lk), dtype=jnp.int32)
+    got = np.asarray(jax.jit(ring)(q, k, v, mask))
+    want = np.asarray(layers.dot_product_attention(q, k, v, mask))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_broadcast_shared_mask(rt):
+    """A [1,1,1,Lk] shared mask (dot_product_attention's broadcast contract)
+    must work on the ring path, not crash shard_map."""
+    ring = make_ring_attention(rt.mesh)
+    q, k, v, _ = _qkvm()
+    shared = np.ones((1, 1, 1, 16), dtype=np.int32)
+    shared[..., -5:] = 0
+    shared = jnp.asarray(shared)
+    got = np.asarray(ring(q, k, v, shared))
+    want = np.asarray(layers.dot_product_attention(q, k, v, shared))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_falls_back_on_incompatible_shapes(rt):
+    ring = make_ring_attention(rt.mesh)
+    # Lq=7 does not divide sp=2 → silent dense path, still correct.
+    q, k, v, _ = _qkvm(B=4, H=4, L=16, D=8)
+    q7 = q[:, :, :7]
+    mask = jnp.ones((4, 1, 1, 16), dtype=jnp.int32)
+    got = np.asarray(ring(q7, k, v, mask))
+    want = np.asarray(layers.dot_product_attention(q7, k, v, mask))
+    np.testing.assert_array_equal(got, want)
+    # Causal (Lq-dim) mask → dense path too.
+    causal = jnp.asarray(layers.causal_mask(16))
+    got = np.asarray(ring(q, k, v, causal))
+    want = np.asarray(layers.dot_product_attention(q, k, v, causal))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sp1_mesh_returns_dense_kernel():
+    rt1 = TpuRuntime(DeviceConfig(mesh_shape={"dp": 8}))
+    assert rt1.attention_fn() is layers.dot_product_attention
+
+
+def test_encoder_forward_with_ring_matches_dense(rt):
+    cfg = encoder.EncoderConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=16, n_classes=10, dtype="float32",
+    )
+    params = encoder.init_params(cfg, model_id="ring-test")
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(0, 64, size=(4, 16)), dtype=jnp.int32)
+    mask = np.ones((4, 16), dtype=np.int32)
+    mask[:, 12:] = 0
+    mask = jnp.asarray(mask)
+    ring = rt.attention_fn()
+    assert ring is not layers.dot_product_attention
+    dense_logits = encoder.forward(params, ids, mask, cfg)
+    ring_logits = encoder.forward(params, ids, mask, cfg, attn_fn=ring)
+    np.testing.assert_allclose(
+        np.asarray(ring_logits), np.asarray(dense_logits), rtol=5e-5, atol=5e-5
+    )
