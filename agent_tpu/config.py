@@ -140,6 +140,9 @@ class DeviceConfig:
     compute_dtype: str = "bfloat16"
     # Persistent XLA compilation cache directory ("" disables).
     compile_cache_dir: str = ""
+    # Fused Pallas attention kernel on TPU (PALLAS_ATTN=0 falls back to the
+    # XLA dot-product path; CPU/GPU always use the XLA path).
+    pallas_attn: bool = True
 
     @staticmethod
     def from_env() -> "DeviceConfig":
@@ -159,6 +162,7 @@ class DeviceConfig:
             mesh_shape=mesh,
             compute_dtype=env_str("COMPUTE_DTYPE", "bfloat16"),
             compile_cache_dir=env_str("JAX_COMPILATION_CACHE_DIR", ""),
+            pallas_attn=env_bool("PALLAS_ATTN", True),
         )
 
 

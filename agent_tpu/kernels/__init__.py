@@ -1,0 +1,18 @@
+"""Hand-written Pallas (Mosaic) TPU kernels for the hot ops.
+
+The reference's only "kernel" was the opaque Edge-TPU interpreter invoke
+(reference ``ops/map_classify_tpu.py:72``). Here XLA compiles almost
+everything well on its own (SURVEY.md §7: "let XLA fuse — don't hand-schedule
+what the compiler already does"), so this package holds only kernels where a
+hand schedule beats XLA's: flash attention, which fuses the QKᵀ → mask →
+softmax → ·V chain into one VMEM-resident pass and never materializes the
+[Lq, Lk] score matrix in HBM.
+
+Every kernel ships with an XLA fallback and an interpret-mode path so the CPU
+test mesh exercises identical code (same-program-different-backend rule,
+SURVEY.md §7).
+"""
+
+from agent_tpu.kernels.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -64,7 +64,14 @@ def _ring_local(q, k, v, mask, sp: int):
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
     def fold(k_blk, v_blk, m_blk, m, l, acc):
-        """Fold one K/V block into the streaming softmax state."""
+        """Fold one K/V block into the streaming softmax state.
+
+        Same m/l/acc update as the Pallas flash kernel's per-tile fold
+        (``agent_tpu.kernels.flash_attention._flash_kernel``) — a numerics
+        change there must land here too. Composing the two (ring hops whose
+        local fold runs the fused kernel) is the open fast path for sp>1 on
+        real TPU.
+        """
         scores = jnp.einsum(
             "bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32)
         )

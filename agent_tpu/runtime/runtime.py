@@ -91,14 +91,33 @@ class TpuRuntime:
         return self.sharding("dp")
 
     def attention_fn(self):
-        """The attention kernel for this mesh: ring attention over ``sp`` when
-        the mesh has a sequence axis, plain dot-product attention otherwise
-        (see ``agent_tpu.parallel.ring``). Built once per runtime; kept out of
-        the executable cache so its stats keep meaning "compiled programs"."""
-        if self._attention_fn is None:
-            from agent_tpu.parallel.ring import make_ring_attention
+        """The attention kernel for this mesh and platform.
 
-            self._attention_fn = make_ring_attention(self.mesh)
+        Selection (built once per runtime; kept out of the executable cache so
+        its stats keep meaning "compiled programs"):
+
+        - mesh has an ``sp`` axis > 1 → ring attention over ``sp``
+          (``agent_tpu.parallel.ring``);
+        - real TPU (and ``PALLAS_ATTN`` not disabled) → the fused Pallas
+          flash kernel (``agent_tpu.kernels.flash_attention``);
+        - otherwise → the dense XLA dot-product path.
+
+        Each choice silently degrades to dense for unsupported shapes, so the
+        returned callable is always a safe drop-in ``attn_fn``.
+        """
+        if self._attention_fn is None:
+            if self.axis_size("sp") > 1:
+                from agent_tpu.parallel.ring import make_ring_attention
+
+                self._attention_fn = make_ring_attention(self.mesh)
+            elif self.platform == "tpu" and self.config.pallas_attn:
+                from agent_tpu.kernels import flash_attention
+
+                self._attention_fn = flash_attention
+            else:
+                from agent_tpu.models.layers import dot_product_attention
+
+                self._attention_fn = dot_product_attention
         return self._attention_fn
 
     def replicated(self) -> NamedSharding:
