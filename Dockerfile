@@ -1,0 +1,35 @@
+# Container image for the agent (successor of reference Dockerfile:1-39).
+# The reference installed the Coral Edge TPU runtime (libedgetpu1-std) from
+# the Coral APT repo; on Cloud TPU the native runtime is libtpu, delivered as
+# a Python wheel via the jax[tpu] extra — no APT layer needed.
+
+FROM python:3.12-slim
+
+ENV PYTHONUNBUFFERED=1 \
+    PYTHONDONTWRITEBYTECODE=1 \
+    PIP_DISABLE_PIP_VERSION_CHECK=1
+
+# g++ compiles the optional native CSV scanner (agent_tpu/data/native) at
+# first use; the agent degrades to the vectorized-numpy scanner without it.
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ \
+ && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+
+COPY pyproject.toml requirements.txt README.md ./
+COPY agent_tpu ./agent_tpu
+
+# TPU wheel index hosts libtpu (the successor of the reference's Coral extra
+# index, reference Dockerfile:25-30). Harmless off-TPU: jax falls back to cpu.
+RUN python -m pip install --no-cache-dir \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+      "jax[tpu]>=0.4.35" && \
+    python -m pip install --no-cache-dir .[metrics]
+
+# Same default env surface as the reference (Dockerfile:35-36).
+ENV CONTROLLER_URL="http://controller:8080"
+ENV AGENT_NAME="agent-tpu-base"
+ENV TASKS="echo,map_classify_tpu"
+
+CMD ["python", "-m", "agent_tpu.agent.app"]

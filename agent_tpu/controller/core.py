@@ -114,6 +114,10 @@ class Controller:
         """
         if shard_size <= 0:
             raise ValueError("shard_size must be positive")
+        if total_rows <= 0:
+            # Zero shards + an immediately-leasable reduce-over-nothing is
+            # never what the caller meant.
+            raise ValueError("total_rows must be positive")
         shard_ids: List[str] = []
         for i, start in enumerate(range(0, total_rows, shard_size)):
             payload = dict(extra_payload or {})

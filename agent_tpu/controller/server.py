@@ -70,6 +70,41 @@ class _Handler(BaseHTTPRequestHandler):
                 error=body.get("error"),
             )
             self._send(200, out)
+        elif self.path == "/v1/jobs":
+            # Operator surface: submit one job or a sharded CSV job.
+            try:
+                if "source_uri" in body:
+                    shard_ids, reduce_id = self.controller.submit_csv_job(
+                        source_uri=str(body["source_uri"]),
+                        total_rows=int(body["total_rows"]),
+                        shard_size=int(body.get("shard_size", 100)),
+                        map_op=str(body.get("map_op", "read_csv_shard")),
+                        extra_payload=body.get("extra_payload"),
+                        reduce_op=body.get("reduce_op"),
+                        reduce_payload=body.get("reduce_payload"),
+                    )
+                    self._send(200, {"job_ids": shard_ids, "reduce_id": reduce_id})
+                else:
+                    job_id = self.controller.submit(
+                        op=str(body["op"]), payload=body.get("payload")
+                    )
+                    self._send(200, {"job_id": job_id})
+            except (KeyError, ValueError, TypeError) as exc:
+                self._send(400, {"error": str(exc)})
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path == "/v1/status":
+            self._send(
+                200,
+                {
+                    "counts": self.controller.counts(),
+                    "drained": self.controller.drained(),
+                    "stale_results": self.controller.stale_results,
+                    "last_metrics": self.controller.last_metrics,
+                },
+            )
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
@@ -119,3 +154,30 @@ class ControllerServer:
 
     def __exit__(self, *exc: Any) -> None:
         self.stop()
+
+
+def main() -> int:
+    """Standalone controller: ``agent-tpu-controller`` / ``python -m
+    agent_tpu.controller.server``. Env: CONTROLLER_HOST (default 0.0.0.0),
+    CONTROLLER_PORT (default 8080), LEASE_TTL_SEC (default 30)."""
+    import signal
+
+    from agent_tpu.config import env_float, env_int, env_str
+
+    host = env_str("CONTROLLER_HOST", "0.0.0.0")
+    port = env_int("CONTROLLER_PORT", 8080)
+    ttl = env_float("LEASE_TTL_SEC", 30.0)
+    server = ControllerServer(Controller(lease_ttl_sec=ttl), host=host, port=port)
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    server.start()
+    print(f"[agent-tpu-controller] serving on {server.url}", flush=True)
+    stop.wait()
+    server.stop()
+    print("[agent-tpu-controller] stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -152,3 +152,58 @@ def flash_attention(
         ),
         interpret=interpret,
     )(q, k, v, mask3d)
+
+
+def make_flash_attention(mesh):
+    """Mesh-aware flash attention: the kernel wrapped in ``shard_map``.
+
+    ``pallas_call`` has no GSPMD partitioning rule, so jitting the bare kernel
+    over a dp/tp mesh silently all-gathers the batch and runs the full-batch
+    kernel replicated on every chip. Wrapping in ``shard_map`` (batch over
+    ``dp``, heads over ``tp``) keeps each chip on its own shard. Single-device
+    meshes skip the wrapper. Shapes the wrapper can't shard (batch or heads
+    indivisible) fall back to the dense XLA path, which GSPMD partitions fine.
+    """
+    shape = dict(mesh.shape)
+    dp = shape.get("dp", 1)
+    tp = shape.get("tp", 1)
+    if mesh.size == 1:
+        return flash_attention
+
+    from jax.sharding import PartitionSpec as P
+
+    sharded = jax.shard_map(
+        flash_attention,
+        mesh=mesh,
+        in_specs=(
+            P("dp", "tp", None, None),
+            P("dp", "tp", None, None),
+            P("dp", "tp", None, None),
+            P("dp", None, None, None),
+        ),
+        out_specs=P("dp", "tp", None, None),
+        # pallas_call's out_shape carries no varying-mesh-axes annotation, so
+        # the vma checker can't see through it; the in/out specs above are the
+        # full contract here.
+        check_vma=False,
+    )
+
+    def mesh_flash_attention(q, k, v, mask):
+        B, H, _, _ = q.shape
+        Lk = k.shape[2]
+        ok = (
+            mask.ndim == 4
+            and mask.shape[1] == 1
+            and mask.shape[2] == 1
+            and mask.shape[0] in (1, B)
+            and mask.shape[3] == Lk
+            and B % dp == 0
+            and H % tp == 0
+        )
+        if not ok:
+            return dot_product_attention(q, k, v, mask)
+        if mask.shape[0] == 1 and B > 1:
+            mask = jnp.broadcast_to(mask, (B, 1, 1, Lk))
+        return sharded(q, k, v, mask)
+
+    return mesh_flash_attention

@@ -111,9 +111,9 @@ class TpuRuntime:
 
                 self._attention_fn = make_ring_attention(self.mesh)
             elif self.platform == "tpu" and self.config.pallas_attn:
-                from agent_tpu.kernels import flash_attention
+                from agent_tpu.kernels import make_flash_attention
 
-                self._attention_fn = flash_attention
+                self._attention_fn = make_flash_attention(self.mesh)
             else:
                 from agent_tpu.models.layers import dot_product_attention
 

@@ -130,3 +130,15 @@ def test_duplicate_job_id_rejected():
     c.submit("echo", {}, job_id="j1")
     with pytest.raises(ValueError):
         c.submit("echo", {}, job_id="j1")
+
+
+def test_submit_csv_job_rejects_nonpositive_total_rows():
+    import pytest as _pytest
+
+    from agent_tpu.controller.core import Controller
+
+    c = Controller()
+    with _pytest.raises(ValueError):
+        c.submit_csv_job("d.csv", total_rows=0, shard_size=100,
+                         reduce_op="risk_accumulate")
+    assert c.counts() == {}  # nothing half-submitted

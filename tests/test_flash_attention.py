@@ -94,6 +94,31 @@ def test_flash_bfloat16_inputs():
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
 
 
+def test_mesh_flash_preserves_dp_sharding():
+    """shard_map-wrapped kernel must keep the batch dp-sharded (the bare
+    pallas_call has no GSPMD rule and would replicate the full batch)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from agent_tpu.kernels import make_flash_attention
+    from agent_tpu.runtime.mesh import build_mesh
+
+    mesh = build_mesh(jax.devices()[:8], {"dp": 4, "tp": 2})
+    fn = make_flash_attention(mesh)
+    q, k, v, mask = _qkvm(B=8, H=4, Lq=16, Lk=16, D=8, pad_tail=3)
+    shard = NamedSharding(mesh, P("dp", "tp", None, None))
+    qs = jax.device_put(q, shard)
+    ks = jax.device_put(k, shard)
+    vs = jax.device_put(v, shard)
+    ms = jax.device_put(mask, NamedSharding(mesh, P("dp", None, None, None)))
+    out = jax.jit(fn)(qs, ks, vs, ms)
+    assert out.sharding.spec == P("dp", "tp", None, None), out.sharding
+    _check(out, q, k, v, mask)
+    # Indivisible heads (H=3 over tp=2) → dense fallback, still correct.
+    got = fn(q[:, :3], k[:, :3], v[:, :3], mask)
+    _check(got, q[:, :3], k[:, :3], v[:, :3], mask)
+
+
 def test_encoder_forward_with_flash_matches_dense():
     from agent_tpu.models import encoder
 
