@@ -24,7 +24,7 @@ COPY agent_tpu ./agent_tpu
 # index, reference Dockerfile:25-30). Harmless off-TPU: jax falls back to cpu.
 RUN python -m pip install --no-cache-dir \
       -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
-      "jax[tpu]>=0.4.35" && \
+      "jax[tpu]>=0.9" && \
     python -m pip install --no-cache-dir .[metrics]
 
 # Same default env surface as the reference (Dockerfile:35-36).

@@ -81,6 +81,10 @@ class Agent:
         self.runtime = runtime
         self.running = True
         self.rate = RateLimiter(self.config.agent.error_log_every_sec)
+        # Multi-host: join the coordination service BEFORE anything touches a
+        # jax backend (sizing probes jax.devices()); jax.distributed must be
+        # first or it refuses and the slice desyncs.
+        self.dist = self._dist_info()
         # Resolve the full op table at startup — unknown/disabled names fail
         # fast here, not mid-lease (the intended design the reference's dead
         # ops_loader.py:8-19 sketched).
@@ -248,6 +252,10 @@ class Agent:
 
         ctx = self._op_context(job_id)
         try:
+            # Multi-host: every host must enter the same SPMD program in
+            # lockstep — the leader publishes the task before executing it
+            # (no-op on a single host). SURVEY.md §7 "multi-host control".
+            self._broadcast_to_followers(op, payload)
             result = fn(payload, ctx)
             status = "succeeded"
             error = None
@@ -287,13 +295,75 @@ class Agent:
             self.run_task(lease_id, task)
         return True
 
-    def run(self, max_steps: Optional[int] = None) -> None:
-        steps = 0
+    # ---- multi-host (leader/follower, SURVEY.md §5.8) ----
+
+    def _dist_info(self):
+        """Process topology; import-light so pure-host agents never touch jax
+        unless multi-host env vars are actually set."""
+        cfg = self.config.device
+        if cfg.coordinator_address is None:
+            from agent_tpu.runtime.distributed import DistInfo
+
+            return DistInfo(process_index=0, process_count=1)
+        from agent_tpu.runtime.distributed import maybe_initialize
+
+        return maybe_initialize(
+            cfg.coordinator_address, cfg.num_processes, cfg.process_id
+        )
+
+    def _broadcast_to_followers(self, op: str, payload: Dict[str, Any]) -> None:
+        if self.dist.process_count == 1:
+            return
+        from agent_tpu.runtime.distributed import broadcast_task
+
+        broadcast_task({"op": op, "payload": payload})
+
+    def run_follower(self) -> None:
+        """Non-leader hosts: execute every task the leader broadcasts, in
+        lockstep, discarding results (the leader posts them). Blocks in the
+        broadcast collective between tasks; exits on the shutdown sentinel."""
+        from agent_tpu.runtime.distributed import broadcast_task, is_shutdown
+
+        log("follower up", process=self.dist.process_index)
         while self.running:
-            self.step()
-            steps += 1
-            if max_steps is not None and steps >= max_steps:
+            task = broadcast_task(None)
+            if task is None or is_shutdown(task):
                 break
+            fn = self.handlers.get(task.get("op"))
+            if fn is None:
+                # The leader only broadcasts ops it resolved — so it is
+                # already inside the SPMD program waiting for our devices.
+                # Skipping would wedge the whole slice in that collective;
+                # failing fast turns a silent hang into a visible crash.
+                raise RuntimeError(
+                    f"follower has no handler for broadcast op "
+                    f"{task.get('op')!r}: TASKS must be identical on every "
+                    f"host of a slice (have {sorted(self.handlers)})"
+                )
+            try:
+                fn(task.get("payload") or {}, self._op_context("follower"))
+            except Exception as exc:  # noqa: BLE001 — never desync the slice
+                self.rate.log("follower", "op raised", type=type(exc).__name__)
+            self.tasks_done += 1
+        log("follower drained", tasks_done=self.tasks_done)
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        info = self.dist
+        if info.process_count > 1 and not info.is_leader:
+            self.run_follower()
+            return
+        steps = 0
+        try:
+            while self.running:
+                self.step()
+                steps += 1
+                if max_steps is not None and steps >= max_steps:
+                    break
+        finally:
+            if info.process_count > 1:
+                from agent_tpu.runtime.distributed import broadcast_shutdown
+
+                broadcast_shutdown()
 
     def shutdown(self, *_args: Any) -> None:
         """Signal handler: finish the in-flight task, then exit the loop

@@ -143,6 +143,10 @@ class DeviceConfig:
     # Fused Pallas attention kernel on TPU (PALLAS_ATTN=0 falls back to the
     # XLA dot-product path; CPU/GPU always use the XLA path).
     pallas_attn: bool = True
+    # Multi-host SPMD (jax.distributed.initialize trio); unset → single host.
+    coordinator_address: Optional[str] = None   # COORDINATOR_ADDRESS host:port
+    num_processes: Optional[int] = None         # NUM_PROCESSES
+    process_id: Optional[int] = None            # PROCESS_ID
 
     @staticmethod
     def from_env() -> "DeviceConfig":
@@ -163,6 +167,15 @@ class DeviceConfig:
             compute_dtype=env_str("COMPUTE_DTYPE", "bfloat16"),
             compile_cache_dir=env_str("JAX_COMPILATION_CACHE_DIR", ""),
             pallas_attn=env_bool("PALLAS_ATTN", True),
+            coordinator_address=os.environ.get("COORDINATOR_ADDRESS") or None,
+            num_processes=(
+                env_int("NUM_PROCESSES", 0) or None
+            ),
+            process_id=(
+                int(os.environ["PROCESS_ID"])
+                if os.environ.get("PROCESS_ID", "").isdigit()
+                else None
+            ),
         )
 
 

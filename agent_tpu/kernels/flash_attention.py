@@ -98,16 +98,14 @@ def flash_attention(
     at 8k context this kernel ran ~4.4× faster than the XLA dense path on a
     v5e chip (which materializes the [Lq, Lk] scores in HBM).
     """
+    from agent_tpu.models.layers import is_key_padding_mask
+
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     bq = min(block_q, Lq)
     bk = min(block_k, Lk)
     supported = (
-        mask.ndim == 4
-        and mask.shape[1] == 1
-        and mask.shape[2] == 1           # key-padding only (no causal/Lq dim)
-        and mask.shape[0] in (1, B)
-        and mask.shape[3] == Lk
+        is_key_padding_mask(mask, B, Lk)
         and Lq % bq == 0
         and Lk % bk == 0
     )
@@ -189,21 +187,16 @@ def make_flash_attention(mesh):
     )
 
     def mesh_flash_attention(q, k, v, mask):
+        from agent_tpu.models.layers import (
+            is_key_padding_mask,
+            materialize_key_padding_mask,
+        )
+
         B, H, _, _ = q.shape
         Lk = k.shape[2]
-        ok = (
-            mask.ndim == 4
-            and mask.shape[1] == 1
-            and mask.shape[2] == 1
-            and mask.shape[0] in (1, B)
-            and mask.shape[3] == Lk
-            and B % dp == 0
-            and H % tp == 0
-        )
+        ok = is_key_padding_mask(mask, B, Lk) and B % dp == 0 and H % tp == 0
         if not ok:
             return dot_product_attention(q, k, v, mask)
-        if mask.shape[0] == 1 and B > 1:
-            mask = jnp.broadcast_to(mask, (B, 1, 1, Lk))
-        return sharded(q, k, v, mask)
+        return sharded(q, k, v, materialize_key_padding_mask(mask, B, Lk))
 
     return mesh_flash_attention

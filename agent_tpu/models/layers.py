@@ -221,6 +221,32 @@ def pad_mask_to_attn(mask: jax.Array) -> jax.Array:
     return mask[:, None, None, :]
 
 
+def is_key_padding_mask(mask: jax.Array, batch: int, lk: int) -> bool:
+    """True iff ``mask`` is a key-padding attention mask ``[B|1, 1, 1, Lk]``.
+
+    The shared contract gate of the fast attention paths (ring in
+    ``agent_tpu.parallel.ring``, Pallas flash in ``agent_tpu.kernels``):
+    shapes that fail it take the dense path. A contract change here changes
+    every fast path at once.
+    """
+    return (
+        mask.ndim == 4
+        and mask.shape[1] == 1
+        and mask.shape[2] == 1              # no causal / per-query dim
+        and mask.shape[0] in (1, batch)
+        and mask.shape[3] == lk
+    )
+
+
+def materialize_key_padding_mask(mask: jax.Array, batch: int, lk: int) -> jax.Array:
+    """Broadcast a shared ``[1, 1, 1, Lk]`` mask to ``[B, 1, 1, Lk]`` — the
+    sharded fast paths partition the batch dim, which a size-1 dim cannot
+    satisfy."""
+    if mask.shape[0] == 1 and batch > 1:
+        return jnp.broadcast_to(mask, (batch, 1, 1, lk))
+    return mask
+
+
 def count_params(params: Params) -> int:
     return int(sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(params)))
 

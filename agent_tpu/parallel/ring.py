@@ -135,14 +135,15 @@ def make_ring_attention(mesh: Mesh):
 
     def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                        mask: jax.Array) -> jax.Array:
+        from agent_tpu.models.layers import (
+            is_key_padding_mask,
+            materialize_key_padding_mask,
+        )
+
         B, H, Lq, _ = q.shape
         Lk = k.shape[2]
         ring_ok = (
-            mask.ndim == 4
-            and mask.shape[1] == 1
-            and mask.shape[2] == 1       # key-padding only, no causal/Lq dim
-            and mask.shape[0] in (1, B)
-            and mask.shape[3] == Lk
+            is_key_padding_mask(mask, B, Lk)
             and B % dp == 0
             and H % tp == 0
             and Lq % sp == 0
@@ -150,10 +151,6 @@ def make_ring_attention(mesh: Mesh):
         )
         if not ring_ok:
             return dot_product_attention(q, k, v, mask)
-        if mask.shape[0] == 1 and B > 1:
-            # Materialize a broadcast (shared) mask: shard_map shards the
-            # batch dim over dp, which a size-1 dim cannot satisfy.
-            mask = jnp.broadcast_to(mask, (B, 1, 1, Lk))
-        return sharded(q, k, v, mask)
+        return sharded(q, k, v, materialize_key_padding_mask(mask, B, Lk))
 
     return ring_attention

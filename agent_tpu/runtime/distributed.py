@@ -1,0 +1,146 @@
+"""Multi-host SPMD wiring — the ICI/DCN two-tier design of SURVEY.md §5.8.
+
+The reference's only inter-node transport was agent↔controller HTTP
+(reference ``app.py:143-158``); there was no agent↔agent communication at
+all. On a multi-host TPU slice that is not enough: every host must enter the
+same XLA program in lockstep or the collective ops deadlock. The design
+(SURVEY.md §7 "hard parts", scaling-book recipe):
+
+- **DCN tier**: exactly one lease loop per pod slice. Host 0 talks to the
+  controller; other hosts never open an HTTP connection.
+- **ICI tier**: host 0 broadcasts each leased task (as bounded JSON) to all
+  hosts via a device all-reduce (`_broadcast_bytes`), then *every* host calls
+  the same op entry point, so the jit-compiled SPMD program runs on the full
+  global mesh. Host 0 alone posts the result.
+
+``jax.distributed.initialize`` is env-gated (COORDINATOR_ADDRESS /
+NUM_PROCESSES / PROCESS_ID — the standard JAX multi-host trio); without it
+everything degrades to the single-process path, so the CPU test mesh and the
+single-chip bench run the identical code.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+MIN_BCAST_BYTES = 1 << 12   # smallest broadcast bucket (4 KiB)
+MAX_TASK_BYTES = 1 << 26    # sanity ceiling (64 MiB) — not a payload budget
+_SHUTDOWN = {"__control__": "shutdown"}
+
+
+@dataclass(frozen=True)
+class DistInfo:
+    process_index: int
+    process_count: int
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_index == 0
+
+
+def maybe_initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> DistInfo:
+    """Initialize JAX multi-host coordination when configured; else no-op.
+
+    Idempotent: a second call (or a call after someone else initialized)
+    returns the live process info without re-initializing.
+    """
+    import jax
+
+    if coordinator_address:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except RuntimeError:
+            # Tolerate only the idempotent case: the service was already
+            # joined, so the process count shows a real multi-process runtime
+            # (and matches num_processes when one was requested). Anything
+            # else — typically "backend already initialized" because
+            # something touched jax.devices() first — must surface:
+            # swallowing it silently degrades this host to single-process
+            # mode while its peers deadlock waiting in collectives.
+            joined = jax.process_count() > 1 and (
+                num_processes is None or jax.process_count() == num_processes
+            )
+            if not joined:
+                raise
+    return DistInfo(
+        process_index=jax.process_index(), process_count=jax.process_count()
+    )
+
+
+def _bucket(n: int) -> int:
+    """Power-of-two buffer bucket ≥ n+8 header bytes — bounded executable
+    count for the shape-specialized broadcast, no hard payload cap."""
+    size = MIN_BCAST_BYTES
+    while size < n + 8:
+        size *= 2
+    return size
+
+
+def _broadcast_bytes(payload: bytes, source: int = 0) -> bytes:
+    """Broadcast ``payload`` from process ``source`` to all processes.
+
+    Two-phase: an 8-byte size broadcast picks the power-of-two bucket, then
+    the payload travels in a buffer of that bucket size — every host compiles
+    the same small set of shapes, and payloads are bounded only by the 64 MiB
+    sanity ceiling (single-host agents have no cap, so multi-host must not
+    quietly impose a much smaller one).
+    """
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return payload
+    if len(payload) > MAX_TASK_BYTES:
+        raise ValueError(
+            f"broadcast payload {len(payload)}B exceeds {MAX_TASK_BYTES}B"
+        )
+    is_source = jax.process_index() == source
+    size_buf = np.zeros(8, dtype=np.uint8)
+    if is_source:
+        size_buf[:] = np.frombuffer(len(payload).to_bytes(8, "little"), np.uint8)
+    size_out = multihost_utils.broadcast_one_to_all(size_buf, is_source=is_source)
+    n = int.from_bytes(bytes(size_out), "little")
+
+    buf = np.zeros(_bucket(n), dtype=np.uint8)
+    if is_source:
+        buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    return bytes(out[:n])
+
+
+def broadcast_task(task: Optional[Dict[str, Any]], source: int = 0
+                   ) -> Optional[Dict[str, Any]]:
+    """Leader broadcasts its leased task dict (or None for 'idle tick') to all
+    hosts; every host returns the same value. Single-process: passthrough."""
+    import jax
+
+    if jax.process_count() == 1:
+        return task
+    if jax.process_index() == source:
+        payload = b"" if task is None else json.dumps(task).encode("utf-8")
+    else:
+        payload = b""
+    raw = _broadcast_bytes(payload, source=source)
+    if not raw:
+        return None
+    return json.loads(raw.decode("utf-8"))
+
+
+def broadcast_shutdown(source: int = 0) -> None:
+    """Leader tells followers to exit their follower loop."""
+    broadcast_task(_SHUTDOWN, source=source)
+
+
+def is_shutdown(task: Optional[Dict[str, Any]]) -> bool:
+    return isinstance(task, dict) and task.get("__control__") == "shutdown"

@@ -59,6 +59,15 @@ class TpuRuntime:
         if self.config.compile_cache_dir:
             # Persistent XLA compile cache: restarts skip recompiles (§5.4).
             jax.config.update("jax_compilation_cache_dir", self.config.compile_cache_dir)
+        # Multi-host: join the coordination service BEFORE device discovery so
+        # jax.devices() reports the global slice (SURVEY.md §5.8).
+        from agent_tpu.runtime.distributed import maybe_initialize
+
+        self.dist = maybe_initialize(
+            self.config.coordinator_address,
+            self.config.num_processes,
+            self.config.process_id,
+        )
         if devices is None:
             platform = detect_platform(self.config.tpu_disabled)
             devices = jax.devices(platform)

@@ -1,0 +1,62 @@
+"""Multi-host wiring: single-process degradation must be exact no-ops.
+
+Real multi-process collectives need a multi-host slice; what CI can prove is
+that the single-process paths (the ones every test/bench run takes) degrade
+cleanly: passthrough broadcasts, leader identity, follower loop that exits
+immediately, and an agent whose behavior is unchanged.
+"""
+
+from agent_tpu.config import Config, DeviceConfig
+from agent_tpu.runtime.distributed import (
+    DistInfo,
+    broadcast_task,
+    is_shutdown,
+    maybe_initialize,
+)
+
+
+def test_maybe_initialize_without_coordinator_is_single_process():
+    info = maybe_initialize(None)
+    assert info == DistInfo(process_index=0, process_count=1)
+    assert info.is_leader
+
+
+def test_broadcast_task_single_process_passthrough():
+    task = {"op": "echo", "payload": {"x": [1, 2, 3]}}
+    assert broadcast_task(task) is task
+    assert broadcast_task(None) is None
+
+
+def test_shutdown_sentinel():
+    from agent_tpu.runtime.distributed import _SHUTDOWN
+
+    assert is_shutdown(_SHUTDOWN)
+    assert not is_shutdown(None)
+    assert not is_shutdown({"op": "echo"})
+
+
+def test_agent_dist_info_default_is_leader(monkeypatch):
+    from agent_tpu.agent.app import Agent
+
+    monkeypatch.setenv("TASKS", "echo")
+    agent = Agent(config=Config.from_env(), session=object())
+    info = agent._dist_info()
+    assert info.process_count == 1 and info.is_leader
+
+
+def test_follower_loop_exits_immediately_single_process(monkeypatch):
+    """process_count == 1 → broadcast returns None → follower drains at once
+    (it can only be entered by mis-configuration in that case)."""
+    from agent_tpu.agent.app import Agent
+
+    monkeypatch.setenv("TASKS", "echo")
+    agent = Agent(config=Config.from_env(), session=object())
+    agent.run_follower()
+    assert agent.tasks_done == 0
+
+
+def test_runtime_exposes_dist_info():
+    from agent_tpu.runtime import TpuRuntime
+
+    rt = TpuRuntime(DeviceConfig())
+    assert rt.dist.process_count == 1 and rt.dist.is_leader

@@ -1,0 +1,126 @@
+"""True multi-process SPMD tests: two local CPU processes joined through
+``jax.distributed`` (the same coordination service a TPU slice uses — here the
+collectives ride Gloo instead of ICI, which is exactly the DCN-tier path).
+
+Each test spawns subprocesses because a JAX process can join a coordination
+service only once per lifetime.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(code: str, *args: str, timeout: float = 120.0):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # 1 local device per process
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(pid), *args],
+            env=env,
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    return procs, outs
+
+
+BROADCAST_CODE = textwrap.dedent("""
+    import sys, os
+    import jax; jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.getcwd())
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    from agent_tpu.runtime.distributed import (
+        broadcast_shutdown, broadcast_task, is_shutdown, maybe_initialize)
+    info = maybe_initialize(f"localhost:{port}", 2, pid)
+    assert info.process_count == 2
+    task = {"op": "echo", "payload": {"msg": "hi", "n": 42}}
+    if info.is_leader:
+        assert broadcast_task(task) == task
+        broadcast_shutdown()
+    else:
+        assert broadcast_task(None) == task
+        assert is_shutdown(broadcast_task(None))
+    print(f"OK {pid}")
+""")
+
+
+AGENT_CODE = textwrap.dedent("""
+    import sys, os
+    import jax; jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.getcwd())
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    os.environ["COORDINATOR_ADDRESS"] = f"localhost:{port}"
+    os.environ["NUM_PROCESSES"] = "2"
+    os.environ["PROCESS_ID"] = str(pid)
+    os.environ["TASKS"] = "echo,risk_accumulate"
+
+    from agent_tpu.config import Config
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+
+    if pid == 0:
+        # Leader host: in-proc controller + leader agent.
+        ctrl = Controller()
+        for i in range(3):
+            ctrl.submit("echo", {"i": i})
+        ctrl.submit("risk_accumulate", {"values": [1.0, 2.0, 3.0]})
+        with ControllerServer(ctrl) as srv:
+            os.environ["CONTROLLER_URL"] = srv.url
+            import requests
+            agent = Agent(config=Config.from_env(), session=requests.Session())
+            while not ctrl.drained():
+                agent.step()
+            agent.running = False
+            agent.run(max_steps=0)   # triggers the finally-broadcast shutdown
+            res = ctrl.results()
+            assert len(res) == 4, res
+            risk = [r for r in res.values() if "sum" in (r or {})][0]
+            assert abs(risk["sum"] - 6.0) < 1e-6, risk
+        print("OK 0")
+    else:
+        # Follower host: no HTTP; lockstep-executes broadcast tasks.
+        agent = Agent(config=Config.from_env(), session=object())
+        agent.run()
+        assert agent.tasks_done == 4, agent.tasks_done
+        print(f"OK 1")
+""")
+
+
+@pytest.mark.parametrize("code,name", [
+    (BROADCAST_CODE, "broadcast"),
+    (AGENT_CODE, "agent_leader_follower"),
+], ids=["broadcast", "agent_leader_follower"])
+def test_two_process_multihost(code, name):
+    port = _free_port()
+    procs, outs = _spawn(code, str(port))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert f"OK {pid}" in out, f"proc {pid} output:\n{out[-3000:]}"
