@@ -215,6 +215,21 @@ class Agent:
             runtime=self.runtime, config=self.config, tags={"job_id": job_id}
         )
 
+    def _maybe_profiled(self, op: str, fn: OpFn, payload: Dict[str, Any],
+                        ctx: Any) -> Any:
+        """Execute the op, capturing an XProf trace for the first
+        ``profile_tasks`` tasks when PROFILE_DIR is set (SURVEY.md §5.1 —
+        result-embedded wall-clock timings flow regardless; traces are the
+        deep-dive channel)."""
+        dev = self.config.device
+        if dev.profile_dir and self.tasks_done < dev.profile_tasks:
+            import jax
+
+            with jax.profiler.trace(dev.profile_dir):
+                with jax.profiler.TraceAnnotation(f"op:{op}"):
+                    return fn(payload, ctx)
+        return fn(payload, ctx)
+
     def run_task(self, lease_id: str, task: Any) -> None:
         """Execute one leased task inline and report its result.
 
@@ -256,7 +271,7 @@ class Agent:
             # lockstep — the leader publishes the task before executing it
             # (no-op on a single host). SURVEY.md §7 "multi-host control".
             self._broadcast_to_followers(op, payload)
-            result = fn(payload, ctx)
+            result = self._maybe_profiled(op, fn, payload, ctx)
             status = "succeeded"
             error = None
         except Exception as exc:  # noqa: BLE001 — every op error → failed result

@@ -147,6 +147,11 @@ class DeviceConfig:
     coordinator_address: Optional[str] = None   # COORDINATOR_ADDRESS host:port
     num_processes: Optional[int] = None         # NUM_PROCESSES
     process_id: Optional[int] = None            # PROCESS_ID
+    # Profiling (SURVEY.md §5.1). PROFILE_DIR: capture XProf traces of the
+    # first PROFILE_TASKS tasks there; PROFILE_PORT: live profiler server.
+    profile_dir: str = ""                       # PROFILE_DIR ("" disables)
+    profile_port: int = 0                       # PROFILE_PORT (0 disables)
+    profile_tasks: int = 1                      # PROFILE_TASKS
 
     @staticmethod
     def from_env() -> "DeviceConfig":
@@ -156,6 +161,11 @@ class DeviceConfig:
                 mesh[k] = int(v)
             except (TypeError, ValueError):
                 pass
+        # PROCESS_ID: forgiving parse like every other int env (env_int), but
+        # unset/unparseable must stay None (= let jax auto-detect), not 0.
+        process_id = (
+            env_int("PROCESS_ID", -1) if os.environ.get("PROCESS_ID") else -1
+        )
         return DeviceConfig(
             model_path=os.environ.get("TPU_MODEL_PATH") or None,
             tpu_disabled=env_bool("TPU_DISABLED", False),
@@ -171,11 +181,10 @@ class DeviceConfig:
             num_processes=(
                 env_int("NUM_PROCESSES", 0) or None
             ),
-            process_id=(
-                int(os.environ["PROCESS_ID"])
-                if os.environ.get("PROCESS_ID", "").isdigit()
-                else None
-            ),
+            process_id=process_id if process_id >= 0 else None,
+            profile_dir=env_str("PROFILE_DIR", ""),
+            profile_port=env_int("PROFILE_PORT", 0),
+            profile_tasks=env_int("PROFILE_TASKS", 1),
         )
 
 

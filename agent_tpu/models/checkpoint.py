@@ -1,0 +1,108 @@
+"""Checkpoint save/restore for model param pytrees (SURVEY.md §5.4).
+
+The reference's "checkpoints" were immutable input artifacts — a compiled
+``.tflite`` blob at a well-known path (reference ``_tpu_runtime.py:23-31``)
+and HF hub weights (reference ``ops/map_summarize.py:29-30``). The framework
+needs the producing side too: training (``models/train.py``) must be able to
+emit an artifact the ops load by path (both model ops accept a ``model_path``
+ending in ``.npz``).
+
+Two formats:
+
+- **``.npz``** (primary): flat dotted-key arrays, the inverse of
+  ``layers.assign_from_npz``. Host-gathered, single-file, dependency-free —
+  right for the op-served model sizes here.
+- **Orbax** (optional): sharded save/restore for params that live distributed
+  over a mesh — each host writes only its shards, nothing is gathered. Used
+  when ``orbax-checkpoint`` is importable; guarded so the framework never
+  requires it.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+import jax
+
+Params = Dict[str, Any]
+
+
+def flatten_params(params: Params, prefix: str = "") -> List[Tuple[str, Any]]:
+    """Pytree → ``[('blocks.0.attn.wq', leaf), ...]`` in deterministic order
+    (the key grammar of ``layers.assign_from_npz``)."""
+    out: List[Tuple[str, Any]] = []
+    if isinstance(params, dict):
+        for k in sorted(params):
+            out.extend(flatten_params(params[k], f"{prefix}{k}."))
+    elif isinstance(params, (list, tuple)):
+        for i, v in enumerate(params):
+            out.extend(flatten_params(v, f"{prefix}{i}."))
+    else:
+        out.append((prefix[:-1], params))
+    return out
+
+
+def save_npz(params: Params, path: str) -> str:
+    """Write a param pytree to ``path`` as a flat ``.npz``; returns ``path``.
+
+    Device arrays are fetched to host (sharded leaves gather transparently).
+    The write is atomic (temp file + rename) so a crash never leaves a
+    half-written artifact at a path an op might load.
+    """
+    flat = {k: np.asarray(v) for k, v in flatten_params(params)}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return path
+
+
+def orbax_available() -> bool:
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def save_orbax(params: Params, path: str) -> str:
+    """Sharded save: each host writes its own shards (no gather)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), params, force=True)
+    return path
+
+
+def load_orbax(path: str, like: Params) -> Params:
+    """Restore with ``like``'s structure/shardings (pass a sharded init pytree
+    to restore distributed — leaves land where ``like``'s leaves live)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path), target=like)
+
+
+def params_equal(a: Params, b: Params, atol: float = 0.0) -> bool:
+    """Exact (or atol-bounded) leaf-wise equality — checkpoint tests' oracle."""
+    fa, fb = flatten_params(a), flatten_params(b)
+    if [k for k, _ in fa] != [k for k, _ in fb]:
+        return False
+    for (_, va), (_, vb) in zip(fa, fb):
+        va, vb = np.asarray(va), np.asarray(vb)
+        if va.shape != vb.shape:
+            return False
+        if not np.allclose(va, vb, rtol=0.0, atol=atol):
+            return False
+    return True

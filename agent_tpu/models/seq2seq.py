@@ -24,8 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from agent_tpu.models import layers
-from agent_tpu.models.layers import Params
-from agent_tpu.models.tokenizer import BOS_ID, EOS_ID
+from agent_tpu.models.layers import NEG_INF, Params
+from agent_tpu.models.tokenizer import BOS_ID, EOS_ID, PAD_ID
 
 
 @dataclass(frozen=True)
@@ -168,6 +168,92 @@ def greedy_generate(
     toks = toks.T  # [B, T]
     lengths = jnp.sum((toks != 0) & (toks != EOS_ID), axis=1)
     return toks, lengths
+
+
+def beam_generate(
+    params: Params,
+    src_ids: jax.Array,    # [B, Ls] int32
+    src_mask: jax.Array,   # [B, Ls] int32
+    cfg: Seq2SeqConfig,
+    max_new_tokens: int,
+    num_beams: int = 4,
+    length_penalty: float = 1.0,
+    attn_fn=layers.dot_product_attention,
+) -> Tuple[jax.Array, jax.Array]:
+    """Beam-search decode under one jit trace — static shapes throughout.
+
+    The reference decoded with torch ``generate(num_beams=4)`` on the host
+    CPU (reference ``ops/map_summarize.py:52-59``). Here beams flatten into
+    the batch dim (``B*K`` rows share the decode-step executable with greedy),
+    every step does one top-K over ``[B, K*V]`` joint scores, and beam
+    reordering gathers the KV caches along the beam axis — all inside
+    ``lax.scan``, so the program never retraces per step.
+
+    Finished beams are frozen: their row's next-token distribution collapses
+    to PAD at zero cost, so their score stops moving. Selection normalizes by
+    ``length ** length_penalty`` (1.0 = mean logprob; 0.0 = raw sum).
+
+    Returns (tokens [B, max_new_tokens], lengths [B]) like
+    :func:`greedy_generate` (``num_beams=1`` reduces to exactly greedy).
+    """
+    B = src_ids.shape[0]
+    K = num_beams
+    V = cfg.vocab_size
+    T = max_new_tokens
+
+    enc_out = encode(params, src_ids, src_mask, cfg, attn_fn=attn_fn)
+    enc_out = jnp.repeat(enc_out, K, axis=0)            # [B*K, Ls, d]
+    enc_mask = jnp.repeat(src_mask, K, axis=0)          # [B*K, Ls]
+    caches = _empty_cache(cfg, B * K)
+
+    tok0 = jnp.full((B * K,), BOS_ID, dtype=jnp.int32)
+    # Step 0: all K beams are identical, so only beam 0 may survive top-K.
+    scores0 = jnp.tile(
+        jnp.array([0.0] + [NEG_INF] * (K - 1), dtype=jnp.float32), (B, 1)
+    )                                                    # [B, K]
+    done0 = jnp.zeros((B, K), dtype=jnp.bool_)
+    toks0 = jnp.zeros((B, K, T), dtype=jnp.int32)
+
+    pad_only = jnp.full((V,), NEG_INF, dtype=jnp.float32).at[PAD_ID].set(0.0)
+
+    def step_fn(carry, step):
+        tok, scores, done, toks, caches = carry
+        logits, caches = _decode_step(
+            params, tok, step, enc_out, enc_mask, caches, cfg
+        )                                                # [B*K, V]
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
+        logp = jnp.where(done[:, :, None], pad_only[None, None, :], logp)
+        flat = (scores[:, :, None] + logp).reshape(B, K * V)
+        new_scores, idx = jax.lax.top_k(flat, K)         # [B, K]
+        beam_idx = idx // V                              # [B, K] parent beam
+        new_tok = (idx % V).astype(jnp.int32)
+
+        toks = jnp.take_along_axis(toks, beam_idx[:, :, None], axis=1)
+        toks = jax.lax.dynamic_update_slice(
+            toks, new_tok[:, :, None], (0, 0, step)
+        )
+        done = jnp.take_along_axis(done, beam_idx, axis=1) | (new_tok == EOS_ID)
+
+        def reorder(c):
+            x = c.reshape(B, K, *c.shape[1:])
+            ix = beam_idx.reshape(B, K, *([1] * (c.ndim - 1)))
+            return jnp.take_along_axis(x, ix, axis=1).reshape(c.shape)
+
+        caches = jax.tree_util.tree_map(reorder, caches)
+        return (new_tok.reshape(B * K), new_scores, done, toks, caches), None
+
+    (_, scores, _, toks, _), _ = jax.lax.scan(
+        step_fn,
+        (tok0, scores0, done0, toks0, caches),
+        jnp.arange(T, dtype=jnp.int32),
+    )
+
+    lengths = jnp.sum((toks != PAD_ID) & (toks != EOS_ID), axis=2)  # [B, K]
+    norm = scores / jnp.maximum(lengths, 1).astype(jnp.float32) ** length_penalty
+    best = jnp.argmax(norm, axis=1)                       # [B]
+    out = jnp.take_along_axis(toks, best[:, None, None], axis=1)[:, 0]
+    out_len = jnp.take_along_axis(lengths, best[:, None], axis=1)[:, 0]
+    return out, out_len
 
 
 def load_npz(path: str, cfg: Seq2SeqConfig) -> Params:

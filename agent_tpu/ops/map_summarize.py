@@ -56,7 +56,7 @@ MAX_BATCH = 1024
 
 
 def _generate(runtime, texts: List[str], model_id: str, cfg,
-              max_new: int) -> Tuple[List[str], str]:
+              max_new: int, num_beams: int = 1) -> Tuple[List[str], str]:
     import jax
 
     from agent_tpu.models import seq2seq
@@ -81,10 +81,19 @@ def _generate(runtime, texts: List[str], model_id: str, cfg,
         ids, mask = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
         B, Ls = ids.shape
         fn = runtime.compiled(
-            ("map_summarize", model_id, B, Ls, max_new, cfg_key(cfg)),
+            ("map_summarize", model_id, B, Ls, max_new, num_beams, cfg_key(cfg)),
             lambda: jax.jit(
-                lambda p, i, m: seq2seq.greedy_generate(
-                    p, i, m, cfg, max_new, attn_fn=attn_fn
+                (
+                    lambda p, i, m: seq2seq.greedy_generate(
+                        p, i, m, cfg, max_new, attn_fn=attn_fn
+                    )
+                )
+                if num_beams <= 1
+                else (
+                    lambda p, i, m: seq2seq.beam_generate(
+                        p, i, m, cfg, max_new, num_beams=num_beams,
+                        attn_fn=attn_fn,
+                    )
                 )
             ),
         )
@@ -116,6 +125,13 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     if isinstance(max_new, bool) or not isinstance(max_new, int) or max_new <= 0:
         return bad_input("max_length must be a positive int")
 
+    # Beam search opt-in (the reference always decoded with num_beams=4,
+    # reference ops/map_summarize.py:57; greedy default keeps the fast path).
+    num_beams = payload.get("num_beams", 1)
+    if isinstance(num_beams, bool) or not isinstance(num_beams, int) or \
+            not 1 <= num_beams <= 16:
+        return bad_input("num_beams must be an int in [1, 16]")
+
     model_id = _resolve_model_id(payload)
     cfg = _get_cfg(payload)
     max_new = min(max_new, cfg.max_tgt_len)
@@ -133,12 +149,15 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
 
         runtime = get_runtime()
 
-    summaries, device = _generate(runtime, texts, model_id, cfg, max_new)
+    summaries, device = _generate(
+        runtime, texts, model_id, cfg, max_new, num_beams=num_beams
+    )
 
     out: Dict[str, Any] = {
         "ok": True,
         "device": device,
         "model": model_id,
+        "num_beams": num_beams,
         "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
     }
     out["summary"] = summaries[0]

@@ -78,10 +78,11 @@ def maybe_initialize(
 
 
 def _bucket(n: int) -> int:
-    """Power-of-two buffer bucket ≥ n+8 header bytes — bounded executable
-    count for the shape-specialized broadcast, no hard payload cap."""
+    """Power-of-two buffer bucket ≥ n — bounded executable count for the
+    shape-specialized broadcast, no hard payload cap. (The payload size
+    travels in its own separate 8-byte broadcast, not in this buffer.)"""
     size = MIN_BCAST_BYTES
-    while size < n + 8:
+    while size < n:
         size *= 2
     return size
 

@@ -73,6 +73,10 @@ class TpuRuntime:
             devices = jax.devices(platform)
         self.devices = list(devices)
         self.platform = self.devices[0].platform
+        if self.config.profile_port:
+            # Live XProf endpoint (SURVEY.md §5.1): `xprof --port` /
+            # TensorBoard can attach to capture device traces on demand.
+            jax.profiler.start_server(self.config.profile_port)
         self.mesh: Mesh = build_mesh(self.devices, self.config.mesh_shape)
         self.cache = ExecutableCache()
         self._params = ExecutableCache()  # build-once dedup, same as executables

@@ -183,3 +183,43 @@ def test_host_metrics_shape():
     if m:  # psutil present
         assert 0.0 <= m["cpu_util"] <= 1.0
         assert m["ram_mb"] >= 0
+
+
+def test_profile_dir_captures_trace(tmp_path, monkeypatch):
+    """PROFILE_DIR → the first task leaves an XProf trace on disk (§5.1)."""
+    import os
+
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.config import Config
+
+    monkeypatch.setenv("TASKS", "echo")
+    monkeypatch.setenv("PROFILE_DIR", str(tmp_path / "traces"))
+
+    class OneLeaseSession:
+        def __init__(self):
+            self.posts = []
+
+        def post(self, url, json=None, timeout=None):
+            class R:
+                status_code = 200
+
+                def __init__(self, body):
+                    self._body = body
+
+                def json(self):
+                    return self._body
+
+            self.posts.append((url, json))
+            if url.endswith("/v1/leases"):
+                return R({"lease_id": "l1", "tasks": [
+                    {"id": "j1", "op": "echo", "payload": {"x": 1},
+                     "job_epoch": 0}]})
+            return R({"accepted": True})
+
+    agent = Agent(config=Config.from_env(), session=OneLeaseSession())
+    agent.step()
+    assert agent.tasks_done == 1
+    trace_root = tmp_path / "traces"
+    assert trace_root.exists()
+    files = [p for p in trace_root.rglob("*") if p.is_file()]
+    assert files, "no trace files written"

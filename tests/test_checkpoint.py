@@ -1,0 +1,116 @@
+"""Checkpoint roundtrips: save_npz is the exact inverse of assign_from_npz,
+ops load the artifact by model_path, and orbax (when present) restores
+sharded."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agent_tpu.models import checkpoint, encoder, seq2seq
+
+
+CFG = encoder.EncoderConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+    max_len=16, n_classes=10, dtype="float32",
+)
+
+
+def _perturbed_params(model_id="ckpt-test"):
+    params = encoder.init_params(CFG, model_id=model_id)
+    # Perturb so a load that silently falls back to deterministic init fails.
+    params["head"]["b"] = params["head"]["b"] + 0.5
+    return params
+
+
+def test_npz_roundtrip_exact(tmp_path):
+    params = _perturbed_params()
+    path = checkpoint.save_npz(params, str(tmp_path / "enc.npz"))
+    loaded = encoder.load_npz(path, CFG)
+    assert checkpoint.params_equal(params, loaded)
+
+
+def test_npz_roundtrip_seq2seq(tmp_path):
+    cfg = seq2seq.Seq2SeqConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_enc_layers=2, n_dec_layers=2,
+        d_ff=64, max_src_len=16, max_tgt_len=8, dtype="float32",
+    )
+    params = seq2seq.init_params(cfg, model_id="ckpt-s2s")
+    path = checkpoint.save_npz(params, str(tmp_path / "s2s.npz"))
+    assert checkpoint.params_equal(params, seq2seq.load_npz(path, cfg))
+
+
+def test_op_loads_saved_checkpoint(tmp_path):
+    """The full §5.4 loop: train-side save → op-side load via model_path."""
+    from agent_tpu.ops import get_op
+
+    params = _perturbed_params()
+    path = checkpoint.save_npz(params, str(tmp_path / "model.npz"))
+    classify = get_op("map_classify_tpu")
+    payload = {
+        "texts": ["checkpointed weights"],
+        "model_path": path,
+        "model_config": {
+            "vocab_size": 64, "d_model": 32, "n_heads": 4, "n_layers": 2,
+            "d_ff": 64, "max_len": 16, "n_classes": 10, "dtype": "float32",
+        },
+        "allow_fallback": False,
+    }
+    out = classify(payload)
+    assert out["ok"] is True and len(out["topk"]) == 5
+
+    # Ground truth: forward with the saved params directly.
+    from agent_tpu.models.tokenizer import ByteTokenizer, pad_batch
+
+    ids, mask = pad_batch([ByteTokenizer().encode("checkpointed weights")[:16]],
+                          buckets=[16])
+    want = np.asarray(encoder.forward(
+        jax.tree_util.tree_map(jnp.asarray, params), ids, mask, CFG
+    ))
+    top1 = int(np.argmax(want[0]))
+    assert out["topk"][0]["index"] == top1
+
+
+def test_save_npz_atomic_no_partial_file(tmp_path):
+    """A failed save must not leave a (partial) file at the target path."""
+    class Boom:
+        shape = (2,)
+
+        def __array__(self):
+            raise RuntimeError("device exploded mid-gather")
+
+    params = {"w": Boom()}
+    target = tmp_path / "broken.npz"
+    with pytest.raises(RuntimeError):
+        checkpoint.save_npz(params, str(target))
+    assert not target.exists()
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+@pytest.mark.skipif(not checkpoint.orbax_available(), reason="no orbax")
+def test_orbax_sharded_roundtrip(tmp_path):
+    """Sharded params save from / restore onto a dp mesh placement."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from agent_tpu.config import DeviceConfig
+    from agent_tpu.runtime import TpuRuntime
+
+    rt = TpuRuntime(DeviceConfig(mesh_shape={"dp": 8}))
+    params = _perturbed_params("orbax-test")
+    sharded = jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(
+            jnp.asarray(leaf), NamedSharding(rt.mesh, P())
+        ),
+        params,
+    )
+    path = str(tmp_path / "orbax_ckpt")
+    checkpoint.save_orbax(sharded, path)
+    like = jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(
+            jnp.zeros_like(leaf), NamedSharding(rt.mesh, P())
+        ),
+        params,
+    )
+    restored = checkpoint.load_orbax(path, like)
+    assert checkpoint.params_equal(params, restored)
