@@ -19,7 +19,7 @@ import sys
 import time
 
 
-def _bench_classify(runtime, batch: int = 1024, text_len: int = 100,
+def _bench_classify(runtime, batch: int = 8192, text_len: int = 100,
                     iters: int = 10):
     from agent_tpu.ops import get_op
     from agent_tpu.runtime.context import OpContext

@@ -34,13 +34,13 @@ def _check(got, q, k, v, mask, rtol=2e-5, atol=2e-5):
 
 def test_flash_matches_dense_single_tile():
     q, k, v, mask = _qkvm(pad_tail=3)
-    _check(flash_attention(q, k, v, mask, interpret=True), q, k, v, mask)
+    _check(flash_attention(q, k, v, mask, min_key_len=0, interpret=True), q, k, v, mask)
 
 
 def test_flash_matches_dense_multi_tile_streaming():
     """Lq and Lk both larger than the tile → real streaming-softmax carry."""
     q, k, v, mask = _qkvm(Lq=32, Lk=48, D=8, pad_tail=5, seed=1)
-    got = flash_attention(q, k, v, mask, block_q=16, block_k=16, interpret=True)
+    got = flash_attention(q, k, v, mask, block_q=16, block_k=16, min_key_len=0, interpret=True)
     _check(got, q, k, v, mask)
 
 
@@ -50,24 +50,24 @@ def test_flash_broadcast_mask_and_cross_lengths():
     shared[..., -7:] = 0
     shared = jnp.asarray(shared)
     got = flash_attention(q, k, v, shared, block_q=16, block_k=16,
-                          interpret=True)
+                          min_key_len=0, interpret=True)
     _check(got, q, k, v, shared)
 
 
 def test_flash_fully_masked_row_is_zero_not_nan():
     q, k, v, mask = _qkvm(seed=3)
     mask = mask.at[1].set(0)
-    got = np.asarray(flash_attention(q, k, v, mask, interpret=True))
+    got = np.asarray(flash_attention(q, k, v, mask, min_key_len=0, interpret=True))
     assert np.isfinite(got).all()
     np.testing.assert_array_equal(got[1], np.zeros_like(got[1]))
-    _check(flash_attention(q, k, v, mask, interpret=True)[0][None],
+    _check(flash_attention(q, k, v, mask, min_key_len=0, interpret=True)[0][None],
            q[0][None], k[0][None], v[0][None], mask[0][None])
 
 
 def test_flash_falls_back_on_causal_mask():
     q, k, v, _ = _qkvm()
     causal = jnp.asarray(layers.causal_mask(16))
-    got = np.asarray(flash_attention(q, k, v, causal, interpret=True))
+    got = np.asarray(flash_attention(q, k, v, causal, min_key_len=0, interpret=True))
     want = np.asarray(layers.dot_product_attention(q, k, v, causal))
     np.testing.assert_array_equal(got, want)
 
@@ -76,7 +76,7 @@ def test_flash_falls_back_on_indivisible_lengths():
     q, k, v, mask = _qkvm(Lq=10, Lk=10)  # 10 % 16 != 0 after min() → bq=10 ok
     # Make it actually indivisible: force tile 16 on Lk=10 via explicit blocks.
     got = np.asarray(
-        flash_attention(q[:, :, :7], k, v, mask, block_q=4, interpret=True)
+        flash_attention(q[:, :, :7], k, v, mask, block_q=4, min_key_len=0, interpret=True)
     )
     want = np.asarray(layers.dot_product_attention(q[:, :, :7], k, v, mask))
     np.testing.assert_array_equal(got, want)
@@ -86,7 +86,7 @@ def test_flash_bfloat16_inputs():
     q, k, v, mask = _qkvm(pad_tail=2, seed=4)
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
     got = np.asarray(
-        flash_attention(qb, kb, vb, mask, interpret=True)
+        flash_attention(qb, kb, vb, mask, min_key_len=0, interpret=True)
     ).astype(np.float32)
     want = np.asarray(
         layers.dot_product_attention(qb, kb, vb, mask)
@@ -134,7 +134,7 @@ def test_encoder_forward_with_flash_matches_dense():
     mask = jnp.asarray(mask)
 
     def attn(q, k, v, m):
-        return flash_attention(q, k, v, m, interpret=True)
+        return flash_attention(q, k, v, m, min_key_len=0, interpret=True)
 
     dense_logits = encoder.forward(params, ids, mask, cfg)
     flash_logits = encoder.forward(params, ids, mask, cfg, attn_fn=attn)
