@@ -60,6 +60,62 @@ OP_TO_MODULE: Dict[str, str] = {
 
 _imported: Dict[str, bool] = {}
 _lock = threading.Lock()
+_plugins_loaded = False
+
+
+def load_plugins(paths: Optional[str] = None) -> List[str]:
+    """Load extra op modules from ``OPS_PLUGIN_PATH`` (``:``-separated files).
+
+    The reference's extension point was an optional ``tpu_ops.py`` imported
+    from beside the app (reference ``app.py:118-123``) that could provide
+    ``map_classify_tpu``. Generalized: each path is executed as a module and
+    its ``@register_op`` decorations land in the shared registry (and in
+    ``OP_TO_MODULE`` so TASKS gating and ``list_ops`` see them). Missing files
+    and import errors are recorded in ``OPS_LOAD_ERRORS``, never raised — the
+    agent must boot without its plugins, like the reference without
+    ``tpu_ops.py`` (ref ``app.py:126-132``).
+
+    Returns the op names newly registered by plugins.
+    """
+    global _plugins_loaded
+    raw = paths if paths is not None else os.environ.get("OPS_PLUGIN_PATH", "")
+    if paths is None:
+        with _lock:
+            if _plugins_loaded:
+                return []
+            _plugins_loaded = True
+    new_names: List[str] = []
+    for path in [p for p in (raw or "").split(":") if p.strip()]:
+        before = set(OPS_REGISTRY)
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                f"agent_tpu_plugin_{abs(hash(path)) & 0xFFFF:04x}", path
+            )
+            if spec is None or spec.loader is None:
+                raise ImportError(f"cannot load plugin {path!r}")
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception as exc:  # noqa: BLE001 — recorded, not raised
+            OPS_LOAD_ERRORS.append((f"plugin:{path}", repr(exc)))
+            # Roll back partial registrations: an op registered by a plugin
+            # that then failed to import would otherwise sit in OPS_REGISTRY
+            # with no OP_TO_MODULE entry — registered but unreachable.
+            for name in set(OPS_REGISTRY) - before:
+                if name not in OP_TO_MODULE:
+                    del OPS_REGISTRY[name]
+            continue
+        for name in set(OPS_REGISTRY) - before:
+            if name in OP_TO_MODULE:
+                # A builtin registered as a side effect of the plugin's own
+                # imports (e.g. `from agent_tpu.ops.echo import run`) — not
+                # the plugin's op; leave its builtin attribution alone.
+                continue
+            OP_TO_MODULE[name] = f"plugin:{path}"
+            _imported[f"plugin:{path}"] = True
+            new_names.append(name)
+    return new_names
 
 
 def register_op(name: str) -> Callable[[OpFn], OpFn]:
@@ -149,6 +205,7 @@ def load_ops(tasks: List[str]) -> Dict[str, OpFn]:
     """Resolve a list of op names at startup; raise early on any unknown/disabled
     name (successor of reference ``ops_loader.py:8-19`` — now actually used by
     the agent)."""
+    load_plugins()  # OPS_PLUGIN_PATH extras join the registry first (once)
     handlers: Dict[str, OpFn] = {}
     for name in tasks:
         handlers[name] = get_op(name)

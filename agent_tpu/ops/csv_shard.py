@@ -62,16 +62,22 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     except OSError as exc:
         return bad_input(f"cannot open {source_uri!r}: {exc}")
 
+    # Reference wire-contract fields (reference ``ops/csv_shard.py:55,86-103``)
+    # ride alongside ours: dataset_id echo, end_row, row_count.
+    dataset_id = payload.get("dataset_id", "unknown_dataset")
     total = index.n_data_rows
     if mode == "count":
         in_range = max(0, min(shard_size, total - start_row))
         return {
             "ok": True,
             "mode": "count",
+            "dataset_id": dataset_id,
             "source_uri": source_uri,
             "start_row": start_row,
+            "end_row": start_row + in_range,
             "shard_size": shard_size,
             "count": in_range,
+            "row_count": in_range,
             "total_rows": total,
         }
 
@@ -79,10 +85,13 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     return {
         "ok": True,
         "mode": "rows",
+        "dataset_id": dataset_id,
         "source_uri": source_uri,
         "start_row": start_row,
+        "end_row": start_row + len(rows),
         "shard_size": shard_size,
         "rows": rows,
         "count": len(rows),
+        "row_count": len(rows),
         "total_rows": total,
     }

@@ -68,9 +68,18 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
             "counts": [len(cs) for cs in per_item],
             "n_items": len(items),
             "n_chunks": len(flat),
+            # Reference wire-contract aliases (reference
+            # ``ops/map_tokenize.py:42-48,56-61``) so reference-era consumers
+            # keep working: tokens == chunks, count == n_chunks,
+            # total_chars; items mode also gets items_count.
+            "tokens": flat,
+            "count": len(flat),
+            "total_chars": sum(len(t) for t in items),
         }
         if single:
             out["n_chars"] = len(items[0])
+        else:
+            out["items_count"] = len(items)
         return out
 
     from agent_tpu.models.tokenizer import get_tokenizer  # lazy: keep import light

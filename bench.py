@@ -48,7 +48,7 @@ def _bench_classify(runtime, batch: int = 8192, text_len: int = 100,
     return rows_per_sec, lat[len(lat) // 2] * 1000.0
 
 
-def _bench_summarize(runtime, batch: int = 8, max_new: int = 32):
+def _bench_summarize(runtime, batch: int = 64, max_new: int = 32):
     from agent_tpu.ops import get_op
     from agent_tpu.runtime.context import OpContext
 

@@ -133,3 +133,37 @@ class TestRiskDeviceThreshold:
         assert risk({"values": [], "device_threshold": "soon"})["ok"] is False
         # ...and a float threshold is fine (it's only compared against).
         assert risk({"values": [1.0], "device_threshold": 8192.0})["ok"] is True
+
+
+def test_csv_shard_reference_wire_contract(tmp_csv):
+    """Reference-era consumers key on dataset_id/end_row/row_count
+    (reference ops/csv_shard.py:86-103) — those aliases must ride along."""
+    from agent_tpu.ops import get_op
+
+    op = get_op("read_csv_shard")
+    out = op({"source_uri": tmp_csv, "start_row": 5, "shard_size": 10,
+              "dataset_id": "ds-1"})
+    assert out["dataset_id"] == "ds-1"
+    assert out["end_row"] == 15 and out["row_count"] == 10
+    cnt = op({"source_uri": tmp_csv, "start_row": 20, "shard_size": 10,
+              "mode": "count"})
+    assert cnt["dataset_id"] == "unknown_dataset"  # reference default
+    assert cnt["row_count"] == cnt["count"] == 6   # 26 rows total
+    assert cnt["end_row"] == 26
+
+
+def test_map_tokenize_chars_reference_wire_contract():
+    """Reference chars-mode keys (reference ops/map_tokenize.py:42-48,56-61):
+    tokens/count/total_chars (+items_count in items mode)."""
+    from agent_tpu.ops import get_op
+
+    op = get_op("map_tokenize")
+    single = op({"text": "a" * 2500, "mode": "chars", "chunk_size": 1024})
+    assert single["tokens"] == single["chunks"]
+    assert single["count"] == single["n_chunks"] == 3
+    assert single["total_chars"] == 2500
+
+    multi = op({"items": ["ab", "cdef"], "mode": "chars", "chunk_size": 3})
+    assert multi["items_count"] == 2
+    assert multi["total_chars"] == 6
+    assert multi["count"] == len(multi["tokens"])

@@ -62,3 +62,93 @@ def test_agent_uses_this_registry():
 
     src = inspect.getsource(agent_app)
     assert "load_ops" in src
+
+
+class TestPlugins:
+    @pytest.fixture(autouse=True)
+    def _isolated_registry(self):
+        """Remove plugin artifacts after each test (order-independence).
+
+        Deliberately surgical, not a wholesale snapshot/restore: a plugin test
+        may import builtin op modules as a side effect, and those stay in
+        ``sys.modules`` — wiping their registry entries would leave the
+        registry permanently out of sync (re-import is a no-op)."""
+        import agent_tpu.ops as ops
+
+        mod_map = dict(ops.OP_TO_MODULE)
+        errs = list(ops.OPS_LOAD_ERRORS)
+        yield
+        for name in list(ops.OP_TO_MODULE):
+            if name not in mod_map:          # plugin-attributed op
+                del ops.OP_TO_MODULE[name]
+                ops.OPS_REGISTRY.pop(name, None)
+        ops.OPS_LOAD_ERRORS[:] = errs
+        for key in list(ops._imported):
+            if key.startswith("plugin:"):
+                del ops._imported[key]
+
+    def test_plugin_ops_register_and_dispatch(self, tmp_path):
+        """OPS_PLUGIN_PATH modules join the registry — the generalized form of
+        the reference's optional tpu_ops.py hook (reference app.py:118-123)."""
+        from agent_tpu.ops import OP_TO_MODULE, get_op, load_plugins
+
+        plug = tmp_path / "my_ops.py"
+        plug.write_text(
+            "from agent_tpu.ops import register_op\n"
+            "@register_op('plugin_double')\n"
+            "def run(payload, ctx=None):\n"
+            "    return {'ok': True, 'doubled': payload['x'] * 2}\n"
+        )
+        new = load_plugins(str(plug))
+        assert new == ["plugin_double"]
+        assert "plugin_double" in OP_TO_MODULE
+        out = get_op("plugin_double")({"x": 21})
+        assert out == {"ok": True, "doubled": 42}
+
+    def test_broken_plugin_is_recorded_not_raised(self, tmp_path):
+        from agent_tpu.ops import OPS_LOAD_ERRORS, load_plugins
+
+        bad = tmp_path / "bad.py"
+        bad.write_text("raise RuntimeError('boom at import')\n")
+        before = len(OPS_LOAD_ERRORS)
+        assert load_plugins(str(bad)) == []
+        assert len(OPS_LOAD_ERRORS) == before + 1
+        assert "boom at import" in OPS_LOAD_ERRORS[-1][1]
+
+    def test_missing_plugin_path_is_recorded(self, tmp_path):
+        from agent_tpu.ops import OPS_LOAD_ERRORS, load_plugins
+
+        before = len(OPS_LOAD_ERRORS)
+        assert load_plugins(str(tmp_path / "nope.py")) == []
+        assert len(OPS_LOAD_ERRORS) == before + 1
+
+    def test_plugin_importing_builtin_does_not_misattribute(self, tmp_path):
+        """A plugin that imports a builtin op module must not claim the
+        builtin's registry entry (or report it as plugin-new)."""
+        from agent_tpu.ops import OP_TO_MODULE, load_plugins
+
+        plug = tmp_path / "reuse.py"
+        plug.write_text(
+            "from agent_tpu.ops.echo import run as _echo\n"
+            "from agent_tpu.ops import register_op\n"
+            "@register_op('echo_twice')\n"
+            "def run(payload, ctx=None):\n"
+            "    return {'ok': True, 'echoes': [_echo(payload), _echo(payload)]}\n"
+        )
+        new = load_plugins(str(plug))
+        assert new == ["echo_twice"]
+        assert OP_TO_MODULE["echo"] == "echo"  # builtin attribution intact
+
+    def test_failed_plugin_rolls_back_partial_registration(self, tmp_path):
+        from agent_tpu.ops import OPS_REGISTRY, load_plugins
+
+        plug = tmp_path / "half.py"
+        plug.write_text(
+            "from agent_tpu.ops import register_op\n"
+            "@register_op('half_op')\n"
+            "def run(payload, ctx=None):\n"
+            "    return {'ok': True}\n"
+            "raise RuntimeError('died after registering')\n"
+        )
+        assert load_plugins(str(plug)) == []
+        assert "half_op" not in OPS_REGISTRY  # no orphaned handler
