@@ -1,0 +1,155 @@
+"""Mixture-of-Experts FFN with expert parallelism over an ``ep`` mesh axis.
+
+The reference had no MoE (SURVEY.md §2.8: "No (no MoE models)"; the mesh
+design brief was "must not preclude it"). This module goes one step further
+and implements it, Mesh-TensorFlow/Switch style, in the einsum-dispatch
+formulation that XLA shards well:
+
+- Router: top-1 gating over ``n_experts`` with a capacity limit per expert
+  (tokens over capacity are dropped — their residual path carries them, the
+  standard Switch behavior).
+- Dispatch/combine are one-hot einsums, so expert inputs materialize as an
+  ``[E, C, d]`` tensor whose expert dim shards over ``ep`` — XLA inserts the
+  all-to-all at the dispatch/combine boundaries when the mesh has an ``ep``
+  axis (``moe_param_specs``/``expert_batch_spec``); on a 1-axis mesh the
+  same program runs unsharded.
+- Static shapes throughout: capacity is computed from a factor at init time,
+  never from data.
+
+``build_mesh`` already accepts arbitrary extra axes (``MESH_SHAPE=
+"dp=2,ep=4"``), so this slots into the existing runtime unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import PartitionSpec as P
+
+from agent_tpu.models import layers
+from agent_tpu.models.layers import Params
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    d_model: int = 128
+    d_ff: int = 512
+    n_experts: int = 4
+    capacity_factor: float = 1.25
+    dtype: str = "float32"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def capacity(self, n_tokens: int) -> int:
+        """Static per-expert token capacity for a given (padded) token count."""
+        return max(1, int(np.ceil(n_tokens / self.n_experts * self.capacity_factor)))
+
+
+def init_moe_ffn(key: jax.Array, cfg: MoeConfig) -> Params:
+    """Router + expert-stacked FFN weights (expert dim first → ep-shardable)."""
+    kr, k1, k2 = jax.random.split(key, 3)
+    scale_in = 1.0 / np.sqrt(cfg.d_model)
+    scale_out = 1.0 / np.sqrt(cfg.d_ff)
+    return {
+        "router": {
+            "w": jax.random.normal(kr, (cfg.d_model, cfg.n_experts), jnp.float32)
+            * scale_in,
+        },
+        "wi": jax.random.normal(
+            k1, (cfg.n_experts, cfg.d_model, cfg.d_ff), jnp.float32
+        ) * scale_in,
+        "wo": jax.random.normal(
+            k2, (cfg.n_experts, cfg.d_ff, cfg.d_model), jnp.float32
+        ) * scale_out,
+    }
+
+
+def moe_param_specs(cfg: MoeConfig) -> Params:
+    """PartitionSpecs: experts over ``ep``, router replicated."""
+    return {
+        "router": {"w": P()},
+        "wi": P("ep", None, None),
+        "wo": P("ep", None, None),
+    }
+
+
+def expert_batch_spec() -> P:
+    """[E, C, d] expert-batch tensors: expert dim over ``ep``."""
+    return P("ep", None, None)
+
+
+def moe_ffn(params: Params, x: jax.Array, cfg: MoeConfig,
+            mesh=None) -> tuple:
+    """Switch FFN. ``x``: [T, d_model] tokens → ([T, d_model], aux_loss).
+
+    Returns the combined expert outputs (zero rows for capacity-dropped
+    tokens — callers add the residual) and the load-balancing auxiliary loss
+    (mean fraction·probability product, Switch §2.2 shape).
+
+    With ``mesh`` given, the [E, C, d] expert batches carry an explicit
+    ``expert_batch_spec`` sharding constraint so the expert dim provably
+    lands on ``ep`` (not left to XLA propagation from the param specs).
+    """
+    dtype = cfg.compute_dtype
+    T = x.shape[0]
+    E = cfg.n_experts
+    C = cfg.capacity(T)
+
+    logits = jnp.dot(x.astype(jnp.float32), params["router"]["w"])  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                          # [T]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)        # [T, E]
+    # Position of each token within its expert's queue (0-based); zero at
+    # non-routed experts, so summing over E extracts the routed position.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot               # [T, E]
+    # one_hot emits an all-zero row for pos >= C — that IS the capacity drop.
+    pos_oh = jax.nn.one_hot(
+        pos.sum(axis=-1).astype(jnp.int32), C, dtype=jnp.float32
+    )                                                                # [T, C]
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :]               # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+
+    def constrain(t):
+        if mesh is None:
+            return t
+        from jax.sharding import NamedSharding
+
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(mesh, expert_batch_spec())
+        )
+
+    expert_in = constrain(jnp.einsum(
+        "tec,td->ecd", dispatch.astype(dtype), x.astype(dtype)
+    ))                                                               # [E, C, d]
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["wi"].astype(dtype)))
+    expert_out = constrain(jnp.einsum("ecf,efd->ecd", h, params["wo"].astype(dtype)))
+    y = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
+
+    # Switch load-balance aux loss: E · Σ_e fraction_e · mean_prob_e.
+    fraction = onehot.mean(axis=0)                                   # [E]
+    mean_prob = probs.mean(axis=0)
+    aux = (fraction * mean_prob).sum() * E
+    return y.astype(x.dtype), aux
+
+
+def moe_block(params: Params, x: jax.Array, cfg: MoeConfig) -> tuple:
+    """Pre-LN residual MoE block over [B, L, d] activations → (y, aux)."""
+    B, L, d = x.shape
+    h = layers.layer_norm(params["ln"], x).reshape(B * L, d)
+    y, aux = moe_ffn(params["moe"], h, cfg)
+    return x + y.reshape(B, L, d), aux
+
+
+def init_moe_block(key: jax.Array, cfg: MoeConfig) -> Params:
+    return {
+        "ln": layers.init_layer_norm(cfg.d_model),
+        "moe": init_moe_ffn(key, cfg),
+    }
