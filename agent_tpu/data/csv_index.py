@@ -151,5 +151,26 @@ def read_shard(path: str, start_row: int, shard_size: int) -> List[Dict[str, str
     return CsvIndex.for_file(path).read_dict_rows(start_row, shard_size)
 
 
+def resolve_shard_payload(payload: Dict) -> Tuple[str, int, int]:
+    """Validate the shared CSV-shard payload keys → (path, start_row,
+    shard_size); raises ValueError on bad input.
+
+    One definition of the shard-addressing contract for every op that accepts
+    it (``read_csv_shard`` and ``map_classify_tpu``'s drain mode) — URI
+    schemes or default changes land here once.
+    """
+    source_uri = payload.get("source_uri")
+    if not isinstance(source_uri, str) or not source_uri:
+        raise ValueError("source_uri is required and must be a non-empty string")
+    start_row = payload.get("start_row", 0)
+    if isinstance(start_row, bool) or not isinstance(start_row, int) or start_row < 0:
+        raise ValueError("start_row must be a non-negative int")
+    shard_size = payload.get("shard_size", 100)
+    if isinstance(shard_size, bool) or not isinstance(shard_size, int) or shard_size <= 0:
+        raise ValueError("shard_size must be a positive int")
+    path = source_uri[len("file://"):] if source_uri.startswith("file://") else source_uri
+    return path, start_row, shard_size
+
+
 def count_rows(path: str) -> int:
     return CsvIndex.for_file(path).n_data_rows

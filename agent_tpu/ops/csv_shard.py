@@ -20,17 +20,11 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from agent_tpu.data.csv_index import CsvIndex
+from agent_tpu.data.csv_index import CsvIndex, resolve_shard_payload
 from agent_tpu.ops import register_op
 from agent_tpu.utils.errors import bad_input
 
 DEFAULT_SHARD_SIZE = 100
-
-
-def _resolve_path(source_uri: str) -> str:
-    if source_uri.startswith("file://"):
-        return source_uri[len("file://") :]
-    return source_uri
 
 
 @register_op("read_csv_shard")
@@ -40,23 +34,18 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     if not isinstance(payload, dict):
         return bad_input("payload must be a dict")
 
-    source_uri = payload.get("source_uri")
-    if not isinstance(source_uri, str) or not source_uri:
-        return bad_input("source_uri is required and must be a non-empty string")
-
-    start_row = payload.get("start_row", 0)
-    if isinstance(start_row, bool) or not isinstance(start_row, int) or start_row < 0:
-        return bad_input("start_row must be a non-negative int")
-
-    shard_size = payload.get("shard_size", DEFAULT_SHARD_SIZE)
-    if isinstance(shard_size, bool) or not isinstance(shard_size, int) or shard_size <= 0:
-        return bad_input("shard_size must be a positive int")
+    try:
+        # Shared shard-addressing contract (also used by map_classify_tpu's
+        # drain mode) — one place defines URI/validation semantics.
+        path, start_row, shard_size = resolve_shard_payload(payload)
+    except ValueError as exc:
+        return bad_input(str(exc))
+    source_uri = payload["source_uri"]
 
     mode = payload.get("mode", "rows")
     if mode not in ("rows", "count"):
         return bad_input(f"mode must be 'rows' or 'count', got {mode!r}")
 
-    path = _resolve_path(source_uri)
     try:
         index = CsvIndex.for_file(path)
     except OSError as exc:

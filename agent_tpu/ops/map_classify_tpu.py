@@ -66,7 +66,15 @@ def _build_params(model_id: str, cfg):
 
 
 def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List[List[int]], bool]:
-    """Payload → (list of token-id sequences, was_single_input)."""
+    """Payload → (list of token-id sequences, was_single_input).
+
+    Accepts, in precedence order: ``input`` (flat token ids, reference
+    contract), ``text``/``texts``, or CSV shard addressing (``source_uri`` +
+    ``start_row``/``shard_size`` + optional ``text_field``) — the last makes
+    a classify task *itself* shard-addressable, so the controller's
+    ``submit_csv_job(map_op="map_classify_tpu")`` drains a dataset without a
+    separate read stage (BASELINE.json 10M-row drain shape).
+    """
     if "input" in payload:
         raw = payload["input"]
         if not isinstance(raw, list) or not raw:
@@ -82,6 +90,27 @@ def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List[List[int]], b
     if texts is None and "text" in payload:
         texts = [payload["text"]]
         single = True  # single iff the row came from 'text'; 'texts' wins
+    if texts is None and isinstance(payload.get("source_uri"), str):
+        from agent_tpu.data.csv_index import read_shard, resolve_shard_payload
+
+        field = payload.get("text_field", "text")
+        if not isinstance(field, str) or not field:
+            raise ValueError("text_field must be a non-empty string")
+        path, start_row, shard_size = resolve_shard_payload(payload)
+        # I/O errors propagate as OSError (NOT ValueError): a transient read
+        # failure must become a *failed* result so the controller retries the
+        # shard — a soft bad_input would silently drop its rows from a drain.
+        rows = read_shard(path, start_row, shard_size)
+        if not rows:
+            raise ValueError(
+                f"shard [{start_row}, {start_row + shard_size}) of {path!r} is empty"
+            )
+        missing = [i for i, r in enumerate(rows) if field not in r]
+        if missing:
+            raise ValueError(
+                f"column {field!r} missing from {len(missing)} rows of {path!r}"
+            )
+        texts = [r[field] for r in rows]
     if texts is not None:
         if not isinstance(texts, list) or not texts or not all(
             isinstance(t, str) for t in texts
@@ -91,7 +120,10 @@ def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List[List[int]], b
 
         tok = ByteTokenizer()
         return [tok.encode(t)[: cfg.max_len] for t in texts], single
-    raise ValueError("payload requires 'input' (token ids), 'text', or 'texts'")
+    raise ValueError(
+        "payload requires 'input' (token ids), 'text'/'texts', or "
+        "'source_uri' CSV shard addressing"
+    )
 
 
 MAX_BATCH = 8192
@@ -233,6 +265,12 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
             device = runtime.platform
             fallback_reason = f"{type(exc).__name__}: {exc}"
         except Exception as cpu_exc:  # noqa: BLE001 — truly degraded
+            if not single:
+                # Batch/drain shards must FAIL (→ controller retry), not
+                # report a degraded empty result that silently drops every
+                # row of the shard; the reference's degraded contract is a
+                # single-row interactive shape (ref :22-28).
+                raise
             return _fail(f"{type(exc).__name__}: {exc}; cpu retry: {cpu_exc}")
 
     from agent_tpu.models.encoder import topk_rows

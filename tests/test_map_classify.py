@@ -149,3 +149,33 @@ def test_texts_wins_over_text_and_returns_all_rows(classify, ctx):
     )
     assert out["ok"] is True
     assert len(out["results"]) == 3  # batch mode: nothing silently dropped
+
+
+def test_classify_from_csv_shard(tmp_csv, classify, ctx):
+    """source_uri shard addressing: the controller can shard a dataset
+    straight into classify tasks (BASELINE 10M-row drain shape)."""
+    out = classify({"source_uri": tmp_csv, "start_row": 2, "shard_size": 4,
+                    "text_field": "text", "topk": 3}, ctx)
+    assert out["ok"] is True and out["n_rows"] == 4
+    assert len(out["results"]) == 4
+
+    # Equivalent to passing the same texts directly.
+    from agent_tpu.data.csv_index import read_shard
+
+    texts = [r["text"] for r in read_shard(tmp_csv, 2, 4)]
+    direct = classify({"texts": texts, "topk": 3}, ctx)
+    assert [r["topk"] for r in out["results"]] == [
+        r["topk"] for r in direct["results"]
+    ]
+
+    # Deterministic data problems → soft errors (retry can't fix them).
+    bad_col = classify({"source_uri": tmp_csv, "text_field": "nope"}, ctx)
+    assert bad_col["ok"] is False
+    empty = classify({"source_uri": tmp_csv, "start_row": 10_000}, ctx)
+    assert empty["ok"] is False
+    # I/O errors → raise (agent reports FAILED, controller retries the shard;
+    # a soft error would silently drop the shard's rows from a drain).
+    import pytest as _pytest
+
+    with _pytest.raises(OSError):
+        classify({"source_uri": "/does/not/exist.csv"}, ctx)
