@@ -26,6 +26,10 @@ import numpy as np
 
 _CHUNK = 1 << 20  # 1 MiB scan chunks
 
+# Default rows per shard (reference ``ops/csv_shard.py:62``) — the single
+# definition every shard-addressed op shares.
+DEFAULT_SHARD_SIZE = 100
+
 
 def _scan_row_offsets_py(path: str) -> np.ndarray:
     """Vectorized quote-aware scan → int64 array of row-start offsets.
@@ -165,7 +169,7 @@ def resolve_shard_payload(payload: Dict) -> Tuple[str, int, int]:
     start_row = payload.get("start_row", 0)
     if isinstance(start_row, bool) or not isinstance(start_row, int) or start_row < 0:
         raise ValueError("start_row must be a non-negative int")
-    shard_size = payload.get("shard_size", 100)
+    shard_size = payload.get("shard_size", DEFAULT_SHARD_SIZE)
     if isinstance(shard_size, bool) or not isinstance(shard_size, int) or shard_size <= 0:
         raise ValueError("shard_size must be a positive int")
     path = source_uri[len("file://"):] if source_uri.startswith("file://") else source_uri

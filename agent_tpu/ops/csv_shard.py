@@ -20,11 +20,13 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from agent_tpu.data.csv_index import CsvIndex, resolve_shard_payload
+from agent_tpu.data.csv_index import (
+    DEFAULT_SHARD_SIZE,  # noqa: F401 — re-export; the wire default lives once
+    CsvIndex,
+    resolve_shard_payload,
+)
 from agent_tpu.ops import register_op
 from agent_tpu.utils.errors import bad_input
-
-DEFAULT_SHARD_SIZE = 100
 
 
 @register_op("read_csv_shard")

@@ -65,8 +65,10 @@ def _build_params(model_id: str, cfg):
     return encoder.init_params(cfg, model_id=model_id)
 
 
-def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List[List[int]], bool]:
-    """Payload → (list of token-id sequences, was_single_input).
+def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List, str, bool]:
+    """Payload → (items, kind, was_single_input); kind is ``"ids"`` (items =
+    token-id lists) or ``"texts"`` (raw strings — tokenization fuses with
+    padding on the hot path, ``byte_encode_pad``).
 
     Accepts, in precedence order: ``input`` (flat token ids, reference
     contract), ``text``/``texts``, or CSV shard addressing (``source_uri`` +
@@ -84,7 +86,7 @@ def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List[List[int]], b
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 raise ValueError("input values must be numeric")
             ids.append(int(v) % cfg.vocab_size)
-        return [ids[: cfg.max_len]], True
+        return [ids[: cfg.max_len]], "ids", True
     texts = payload.get("texts")
     single = False
     if texts is None and "text" in payload:
@@ -97,17 +99,19 @@ def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List[List[int]], b
         if not isinstance(field, str) or not field:
             raise ValueError("text_field must be a non-empty string")
         path, start_row, shard_size = resolve_shard_payload(payload)
-        # I/O errors propagate as OSError (NOT ValueError): a transient read
-        # failure must become a *failed* result so the controller retries the
-        # shard — a soft bad_input would silently drop its rows from a drain.
+        # Errors here must be LOUD, not soft: in a drain, a soft bad_input
+        # result is recorded as succeeded and the shard's rows silently
+        # vanish. I/O errors propagate as OSError; shard-level data-integrity
+        # problems raise RuntimeError — both become *failed* results the
+        # controller retries once and then visibly marks failed.
         rows = read_shard(path, start_row, shard_size)
         if not rows:
-            raise ValueError(
+            raise RuntimeError(
                 f"shard [{start_row}, {start_row + shard_size}) of {path!r} is empty"
             )
         missing = [i for i, r in enumerate(rows) if field not in r]
         if missing:
-            raise ValueError(
+            raise RuntimeError(
                 f"column {field!r} missing from {len(missing)} rows of {path!r}"
             )
         texts = [r[field] for r in rows]
@@ -116,10 +120,7 @@ def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List[List[int]], b
             isinstance(t, str) for t in texts
         ):
             raise ValueError("texts must be a non-empty list of strings")
-        from agent_tpu.models.tokenizer import ByteTokenizer
-
-        tok = ByteTokenizer()
-        return [tok.encode(t)[: cfg.max_len] for t in texts], single
+        return texts, "texts", single
     raise ValueError(
         "payload requires 'input' (token ids), 'text'/'texts', or "
         "'source_uri' CSV shard addressing"
@@ -130,21 +131,27 @@ MAX_BATCH = 8192
 
 
 def _run_on_runtime(
-    runtime, seqs: List[List[int]], model_id: str, cfg, k: int
+    runtime, items: List, kind: str, model_id: str, cfg, k: int
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Classify ``seqs`` → (topk values [N, k], topk indices [N, k]).
+    """Classify ``items`` (token-id lists or raw texts, per ``kind``) →
+    (topk values [N, k], topk indices [N, k]).
 
     Top-k runs on device, fused into the forward executable: the host fetches
     k probabilities per row, not [B, n_classes] logits — at bench shapes that
     is a ~100× smaller device→host transfer. Chunks dispatch asynchronously
     and are fetched after the loop, so host staging of chunk i+1 overlaps
-    device compute of chunk i.
+    device compute of chunk i. Text chunks tokenize+pad in one fused numpy
+    pass (``byte_encode_pad``).
     """
     import jax
     import jax.numpy as jnp
 
     from agent_tpu.models import encoder
-    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS, pad_batch
+    from agent_tpu.models.tokenizer import (
+        DEFAULT_BUCKETS,
+        byte_encode_pad,
+        pad_batch,
+    )
     from agent_tpu.ops._model_common import batch_buckets, cfg_key, iter_chunks
 
     dp = runtime.axis_size("dp")
@@ -159,14 +166,21 @@ def _run_on_runtime(
     attn_fn = runtime.attention_fn()  # ring over sp when the mesh has one
     pending: List[Tuple[Any, Any, int]] = []
     # Oversize batches run as extra device calls on the top bucket shape.
-    for chunk in iter_chunks(seqs, bbuckets[-1]):
-        ids, _ = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
-        B, L = ids.shape
+    for chunk in iter_chunks(items, bbuckets[-1]):
+        if kind == "texts":
+            ids, lengths = byte_encode_pad(
+                chunk, buckets=buckets, batch_buckets=bbuckets,
+                max_len_cap=cfg.max_len,
+            )
+            B, L = ids.shape
+        else:
+            ids, _ = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
+            B, L = ids.shape
+            lengths = np.zeros(B, dtype=np.int32)
+            lengths[: len(chunk)] = [min(len(s), L) for s in chunk]
         # Host→device traffic is the per-task tax: ship uint16 ids (vocab
         # 260 > uint8) + one length per row, and rebuild the int32 ids and
         # the [B, L] mask on device — 4× less than int32 ids + int32 mask.
-        lengths = np.zeros(B, dtype=np.int32)
-        lengths[: len(chunk)] = [min(len(s), L) for s in chunk]
 
         def build(L=L):
             def run(p, i, n):
@@ -240,7 +254,7 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
 
     try:
         cfg = _get_cfg(payload)
-        seqs, single = _collect_sequences(payload, cfg)
+        items, kind, single = _collect_sequences(payload, cfg)
     except ValueError as exc:
         return bad_input(str(exc))
 
@@ -254,14 +268,14 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
             from agent_tpu.runtime.runtime import get_runtime
 
             runtime = get_runtime()
-        vals, idx = _run_on_runtime(runtime, seqs, model_id, cfg, k)
+        vals, idx = _run_on_runtime(runtime, items, kind, model_id, cfg, k)
         device = runtime.platform
     except Exception as exc:  # noqa: BLE001 — any device failure → fallback path
         if not allow_fallback:
             raise
         try:
             runtime = _get_cpu_runtime()
-            vals, idx = _run_on_runtime(runtime, seqs, model_id, cfg, k)
+            vals, idx = _run_on_runtime(runtime, items, kind, model_id, cfg, k)
             device = runtime.platform
             fallback_reason = f"{type(exc).__name__}: {exc}"
         except Exception as cpu_exc:  # noqa: BLE001 — truly degraded
@@ -281,7 +295,7 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         "op": "map_classify_tpu",
         "model_path": model_id,
         "device": device,
-        "n_rows": len(seqs),
+        "n_rows": len(items),
         "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
     }
     if fallback_reason is not None:

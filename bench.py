@@ -136,6 +136,13 @@ def _bench_drain(runtime, n_rows: int = 65_536, shard_size: int = 8192):
             wall = time.perf_counter() - t0
             counts = controller.counts()
             assert counts.get("failed", 0) == 0, counts
+            # Soft-failed shards are recorded SUCCEEDED — check result bodies
+            # so a drain that classified nothing can't report throughput.
+            bad = [
+                r for r in controller.results().values()
+                if not (isinstance(r, dict) and r.get("ok") is True)
+            ]
+            assert not bad, f"{len(bad)} shards returned non-ok results"
     return n_rows / wall
 
 

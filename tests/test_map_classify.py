@@ -168,14 +168,14 @@ def test_classify_from_csv_shard(tmp_csv, classify, ctx):
         r["topk"] for r in direct["results"]
     ]
 
-    # Deterministic data problems → soft errors (retry can't fix them).
-    bad_col = classify({"source_uri": tmp_csv, "text_field": "nope"}, ctx)
-    assert bad_col["ok"] is False
-    empty = classify({"source_uri": tmp_csv, "start_row": 10_000}, ctx)
-    assert empty["ok"] is False
-    # I/O errors → raise (agent reports FAILED, controller retries the shard;
-    # a soft error would silently drop the shard's rows from a drain).
+    # Every shard-level problem must raise (agent reports FAILED, controller
+    # retries then visibly marks failed) — a soft {ok: false} result would be
+    # recorded as SUCCEEDED and the shard's rows silently vanish from a drain.
     import pytest as _pytest
 
+    with _pytest.raises(RuntimeError):
+        classify({"source_uri": tmp_csv, "text_field": "nope"}, ctx)
+    with _pytest.raises(RuntimeError):
+        classify({"source_uri": tmp_csv, "start_row": 10_000}, ctx)
     with _pytest.raises(OSError):
         classify({"source_uri": "/does/not/exist.csv"}, ctx)

@@ -63,3 +63,24 @@ def test_pad_batch_batch_buckets():
     ids, mask = pad_batch([[1, 2]] * 3, batch_buckets=(4, 8))
     assert ids.shape == (4, 16)
     assert mask[3].sum() == 0  # appended all-pad row
+
+
+def test_byte_encode_pad_matches_encode_plus_pad():
+    """The fused fast path must produce exactly encode()+pad_batch ids."""
+    import numpy as np
+
+    from agent_tpu.models.tokenizer import (
+        ByteTokenizer, byte_encode_pad, pad_batch,
+    )
+
+    texts = ["hello world", "ünïcödé £ text", "", "a" * 300, "nul\x00byte"]
+    tok = ByteTokenizer()
+    seqs = [tok.encode(t)[:128] for t in texts]
+    want_ids, want_mask = pad_batch(seqs, buckets=[16, 64, 128],
+                                    batch_buckets=[8])
+    got_ids, got_lengths = byte_encode_pad(texts, buckets=[16, 64, 128],
+                                           batch_buckets=[8], max_len_cap=128)
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_array_equal(
+        got_lengths, want_mask.sum(axis=1).astype(np.int32)
+    )
