@@ -178,3 +178,30 @@ def resolve_shard_payload(payload: Dict) -> Tuple[str, int, int]:
 
 def count_rows(path: str) -> int:
     return CsvIndex.for_file(path).n_data_rows
+
+
+def read_shard_texts(payload: Dict, default_field: str = "text") -> List[str]:
+    """Shard-addressed payload → the shard's text column, for drain-mode ops
+    (classify and summarize must treat the same CSV identically).
+
+    Error contract: malformed payload keys raise ValueError (deterministic
+    caller error → soft ``bad_input``); shard-level integrity problems (empty
+    shard, missing column) raise RuntimeError and I/O problems raise OSError —
+    both must surface as *failed* task results so the controller retries and
+    then visibly fails, never as soft results that drop the shard's rows.
+    """
+    field = payload.get("text_field", default_field)
+    if not isinstance(field, str) or not field:
+        raise ValueError("text_field must be a non-empty string")
+    path, start_row, shard_size = resolve_shard_payload(payload)
+    rows = read_shard(path, start_row, shard_size)
+    if not rows:
+        raise RuntimeError(
+            f"shard [{start_row}, {start_row + shard_size}) of {path!r} is empty"
+        )
+    missing = sum(1 for r in rows if field not in r)
+    if missing:
+        raise RuntimeError(
+            f"column {field!r} missing from {missing} rows of {path!r}"
+        )
+    return [r[field] for r in rows]

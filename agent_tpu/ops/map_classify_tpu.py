@@ -92,29 +92,13 @@ def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List, str, bool]:
     if texts is None and "text" in payload:
         texts = [payload["text"]]
         single = True  # single iff the row came from 'text'; 'texts' wins
-    if texts is None and isinstance(payload.get("source_uri"), str):
-        from agent_tpu.data.csv_index import read_shard, resolve_shard_payload
+    if texts is None and "source_uri" in payload:
+        from agent_tpu.data.csv_index import read_shard_texts
 
-        field = payload.get("text_field", "text")
-        if not isinstance(field, str) or not field:
-            raise ValueError("text_field must be a non-empty string")
-        path, start_row, shard_size = resolve_shard_payload(payload)
-        # Errors here must be LOUD, not soft: in a drain, a soft bad_input
-        # result is recorded as succeeded and the shard's rows silently
-        # vanish. I/O errors propagate as OSError; shard-level data-integrity
-        # problems raise RuntimeError — both become *failed* results the
-        # controller retries once and then visibly marks failed.
-        rows = read_shard(path, start_row, shard_size)
-        if not rows:
-            raise RuntimeError(
-                f"shard [{start_row}, {start_row + shard_size}) of {path!r} is empty"
-            )
-        missing = [i for i, r in enumerate(rows) if field not in r]
-        if missing:
-            raise RuntimeError(
-                f"column {field!r} missing from {len(missing)} rows of {path!r}"
-            )
-        texts = [r[field] for r in rows]
+        # Shared drain-mode contract (also map_summarize's): ValueError →
+        # soft bad_input; RuntimeError/OSError propagate so the shard FAILS
+        # and the controller retries instead of silently dropping its rows.
+        texts = read_shard_texts(payload)
     if texts is not None:
         if not isinstance(texts, list) or not texts or not all(
             isinstance(t, str) for t in texts
@@ -257,6 +241,7 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         items, kind, single = _collect_sequences(payload, cfg)
     except ValueError as exc:
         return bad_input(str(exc))
+    t_staged = time.perf_counter()
 
     # Clamp k to the class count so lax.top_k stays legal for any payload.
     k = min(topk, cfg.n_classes)
@@ -286,6 +271,15 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
                 # single-row interactive shape (ref :22-28).
                 raise
             return _fail(f"{type(exc).__name__}: {exc}; cpu retry: {cpu_exc}")
+
+    t_device = time.perf_counter()
+    if ctx is not None and hasattr(ctx, "tags"):
+        # Per-stage trace (SURVEY.md §5.1): staging = payload → token rows
+        # (incl. shard read), device = pad + transfer + compute + fetch.
+        ctx.tags.setdefault("timings", {}).update(
+            stage_ms=round((t_staged - t0) * 1000.0, 3),
+            device_ms=round((t_device - t_staged) * 1000.0, 3),
+        )
 
     from agent_tpu.models.encoder import topk_rows
 

@@ -110,8 +110,19 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         return bad_input("payload must be a dict")
 
     texts = payload.get("texts")
-    single = texts is None
-    if single:
+    single = texts is None and "source_uri" not in payload
+    if texts is None and "source_uri" in payload:
+        # CSV shard addressing — the summarize half of the BASELINE.json
+        # classify+summarize drain. Shared contract with classify
+        # (``read_shard_texts``): ValueError → soft bad_input; shard
+        # integrity / I/O problems raise so the shard FAILS and retries.
+        from agent_tpu.data.csv_index import read_shard_texts
+
+        try:
+            texts = read_shard_texts(payload)
+        except ValueError as exc:
+            return bad_input(str(exc))
+    elif single:
         text = payload.get("text")
         if not isinstance(text, str) or not text:
             return bad_input("payload requires a non-empty 'text' string")
@@ -138,6 +149,11 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
 
     from agent_tpu.config import env_bool
 
+    # stage = payload → texts (incl. shard read); runtime acquisition and
+    # beyond is device time — same attribution as map_classify_tpu so the
+    # shared timings schema means one thing across ops.
+    t_staged = time.perf_counter()
+
     if env_bool("SUMMARIZE_FORCE_CPU", False):
         from agent_tpu.ops.map_classify_tpu import _get_cpu_runtime
 
@@ -152,6 +168,11 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     summaries, device = _generate(
         runtime, texts, model_id, cfg, max_new, num_beams=num_beams
     )
+    if ctx is not None and hasattr(ctx, "tags"):
+        ctx.tags.setdefault("timings", {}).update(
+            stage_ms=round((t_staged - t0) * 1000.0, 3),
+            device_ms=round((time.perf_counter() - t_staged) * 1000.0, 3),
+        )
 
     out: Dict[str, Any] = {
         "ok": True,

@@ -225,3 +225,37 @@ class TestBeamSearch:
         assert len(out["summaries"]) == 2
         bad = summarize({**payload, "num_beams": 0})
         assert bad["ok"] is False
+
+
+def test_summarize_from_csv_shard(tmp_csv):
+    """source_uri shard addressing — the summarize half of the drain story."""
+    import pytest as _pytest
+
+    from agent_tpu.ops import get_op
+
+    summarize = get_op("map_summarize")
+    cfg_kw = {"vocab_size": 260, "d_model": 32, "n_heads": 4,
+              "n_enc_layers": 2, "n_dec_layers": 2, "d_ff": 64,
+              "max_src_len": 64, "max_tgt_len": 8, "dtype": "float32"}
+    out = summarize({"source_uri": tmp_csv, "start_row": 1, "shard_size": 3,
+                     "text_field": "text", "max_length": 4,
+                     "model_config": cfg_kw})
+    assert out["ok"] is True and len(out["summaries"]) == 3
+    # Shard problems raise loudly (drain semantics), same as classify.
+    with _pytest.raises(RuntimeError):
+        summarize({"source_uri": tmp_csv, "start_row": 10_000,
+                   "model_config": cfg_kw})
+    with _pytest.raises(RuntimeError):
+        summarize({"source_uri": tmp_csv, "text_field": "nope",
+                   "model_config": cfg_kw})
+
+
+def test_op_timings_flow_through_context():
+    from agent_tpu.ops import get_op
+    from agent_tpu.runtime.context import OpContext
+
+    ctx = OpContext()
+    out = get_op("map_classify_tpu")({"texts": ["timing check"], "topk": 2}, ctx)
+    assert out["ok"] is True
+    t = ctx.tags["timings"]
+    assert t["stage_ms"] >= 0 and t["device_ms"] > 0
