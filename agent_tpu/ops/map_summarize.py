@@ -111,6 +111,7 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
 
     texts = payload.get("texts")
     single = texts is None and "source_uri" not in payload
+    empty_rows: List[int] = []  # drain-mode blank cells → empty summaries
     if texts is None and "source_uri" in payload:
         # CSV shard addressing — the summarize half of the BASELINE.json
         # classify+summarize drain. Shared contract with classify
@@ -122,6 +123,13 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
             texts = read_shard_texts(payload)
         except ValueError as exc:
             return bad_input(str(exc))
+        # Messy data is normal in drains: blank cells get an empty summary
+        # (overwritten after generation) instead of failing the shard or
+        # emitting model output for no input — the payload 'texts' path
+        # keeps its strict non-empty contract.
+        empty_rows = [i for i, t in enumerate(texts) if not t]
+        if empty_rows:
+            texts = [t or " " for t in texts]
     elif single:
         text = payload.get("text")
         if not isinstance(text, str) or not text:
@@ -168,6 +176,8 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     summaries, device = _generate(
         runtime, texts, model_id, cfg, max_new, num_beams=num_beams
     )
+    for i in empty_rows:
+        summaries[i] = ""  # no input → no summary, not model noise
     if ctx is not None and hasattr(ctx, "tags"):
         ctx.tags.setdefault("timings", {}).update(
             stage_ms=round((t_staged - t0) * 1000.0, 3),

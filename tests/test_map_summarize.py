@@ -259,3 +259,19 @@ def test_op_timings_flow_through_context():
     assert out["ok"] is True
     t = ctx.tags["timings"]
     assert t["stage_ms"] >= 0 and t["device_ms"] > 0
+
+
+def test_summarize_drain_blank_cells_get_empty_summaries(tmp_path):
+    from agent_tpu.ops import get_op
+
+    path = tmp_path / "blanks.csv"
+    path.write_text('id,text\n0,"real document text"\n1,""\n2,"another doc"\n')
+    out = get_op("map_summarize")({
+        "source_uri": str(path), "shard_size": 3, "max_length": 4,
+        "model_config": {"vocab_size": 260, "d_model": 32, "n_heads": 4,
+                         "n_enc_layers": 2, "n_dec_layers": 2, "d_ff": 64,
+                         "max_src_len": 64, "max_tgt_len": 8,
+                         "dtype": "float32"},
+    })
+    assert out["ok"] is True
+    assert out["summaries"][1] == ""          # blank cell → empty summary
