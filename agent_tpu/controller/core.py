@@ -53,6 +53,10 @@ class Job:
     attempts: int = 0
     # Jobs that must complete before this one becomes leasable (reduce stages).
     after: Set[str] = field(default_factory=set)
+    # Label constraints: every key must appear in the leasing agent's labels,
+    # and non-True values must match (the consumer side of the AGENT_LABELS
+    # channel the protocol has always carried, reference app.py:49-63,168).
+    required_labels: Dict[str, Any] = field(default_factory=dict)
 
     def to_task(self) -> Dict[str, Any]:
         return {
@@ -87,9 +91,16 @@ class Controller:
         payload: Optional[Dict[str, Any]] = None,
         job_id: Optional[str] = None,
         after: Optional[Set[str]] = None,
+        required_labels: Optional[Dict[str, Any]] = None,
     ) -> str:
         job_id = job_id or f"job-{uuid.uuid4().hex[:12]}"
-        job = Job(job_id=job_id, op=op, payload=payload or {}, after=set(after or ()))
+        job = Job(
+            job_id=job_id,
+            op=op,
+            payload=payload or {},
+            after=set(after or ()),
+            required_labels=dict(required_labels or {}),
+        )
         with self._lock:
             if job_id in self._jobs:
                 raise ValueError(f"duplicate job id {job_id!r}")
@@ -106,6 +117,7 @@ class Controller:
         extra_payload: Optional[Dict[str, Any]] = None,
         reduce_op: Optional[str] = None,
         reduce_payload: Optional[Dict[str, Any]] = None,
+        required_labels: Optional[Dict[str, Any]] = None,
     ) -> Tuple[List[str], Optional[str]]:
         """Split a CSV dataset into shard tasks (+ optional gated reduce job).
 
@@ -126,11 +138,21 @@ class Controller:
                 start_row=start,
                 shard_size=min(shard_size, total_rows - start),
             )
-            shard_ids.append(self.submit(map_op, payload, job_id=f"shard-{i}-{uuid.uuid4().hex[:8]}"))
+            shard_ids.append(
+                self.submit(
+                    map_op,
+                    payload,
+                    job_id=f"shard-{i}-{uuid.uuid4().hex[:8]}",
+                    required_labels=required_labels,
+                )
+            )
         reduce_id = None
         if reduce_op is not None:
             reduce_id = self.submit(
-                reduce_op, dict(reduce_payload or {}), after=set(shard_ids)
+                reduce_op,
+                dict(reduce_payload or {}),
+                after=set(shard_ids),
+                required_labels=required_labels,
             )
         return shard_ids, reduce_id
 
@@ -169,6 +191,25 @@ class Controller:
             if d in self._jobs
         )
 
+    @staticmethod
+    def _labels_match(job: Job, labels: Dict[str, Any]) -> bool:
+        """Every required label must be present; a required value of True
+        accepts any truthy advertisement (bare-token labels parse to True).
+
+        Value comparison is string-coerced: the AGENT_LABELS env grammar only
+        produces strings (or True), so a JSON-typed requirement like
+        ``{"mem_gb": 16}`` must still match an agent advertising ``"16"`` —
+        a strict type-sensitive compare would starve the job silently.
+        """
+        for key, want in job.required_labels.items():
+            have = labels.get(key)
+            if want is True:
+                if not have:  # absent or falsy (False/""/0) → not satisfied
+                    return False
+            elif have is None or str(have) != str(want):
+                return False
+        return True
+
     def lease(
         self,
         agent: str,
@@ -176,10 +217,12 @@ class Controller:
         max_tasks: int = 1,
         worker_profile: Optional[Dict[str, Any]] = None,
         metrics: Optional[Dict[str, Any]] = None,
+        labels: Optional[Dict[str, Any]] = None,
         **_ignored: Any,
     ) -> Optional[Dict[str, Any]]:
         """One lease request → ``{lease_id, tasks}`` or None (HTTP 204)."""
         ops = set((capabilities or {}).get("ops") or [])
+        labels = labels or {}
         with self._lock:
             if metrics:
                 self.last_metrics = metrics
@@ -201,6 +244,7 @@ class Controller:
                     len(tasks) < max(1, max_tasks)
                     and job.state == PENDING
                     and (not ops or job.op in ops)
+                    and self._labels_match(job, labels)
                     and self._deps_done_locked(job)
                 ):
                     job.state = LEASED

@@ -55,6 +55,9 @@ class _Handler(BaseHTTPRequestHandler):
                 max_tasks=int(body.get("max_tasks", 1) or 1),
                 worker_profile=body.get("worker_profile"),
                 metrics=body.get("metrics"),
+                labels=body.get("labels")
+                if isinstance(body.get("labels"), dict)
+                else None,
             )
             if lease is None:
                 self._send(204)
@@ -82,11 +85,14 @@ class _Handler(BaseHTTPRequestHandler):
                         extra_payload=body.get("extra_payload"),
                         reduce_op=body.get("reduce_op"),
                         reduce_payload=body.get("reduce_payload"),
+                        required_labels=body.get("required_labels"),
                     )
                     self._send(200, {"job_ids": shard_ids, "reduce_id": reduce_id})
                 else:
                     job_id = self.controller.submit(
-                        op=str(body["op"]), payload=body.get("payload")
+                        op=str(body["op"]),
+                        payload=body.get("payload"),
+                        required_labels=body.get("required_labels"),
                     )
                     self._send(200, {"job_id": job_id})
             except (KeyError, ValueError, TypeError) as exc:

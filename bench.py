@@ -18,9 +18,19 @@ import os
 import sys
 import time
 
+# Measurement configuration — single definitions shared by the bench
+# functions and the bench_params field in the output line, so the recorded
+# config can never drift from the executed one.
+CLASSIFY_BATCH = 8192
+CLASSIFY_ITERS = 10
+CLASSIFY_WINDOWS = 2
+SUMMARIZE_BATCH = 256
+SUMMARIZE_MAX_NEW = 32
+DRAIN_ROWS = 65_536
 
-def _bench_classify(runtime, batch: int = 8192, text_len: int = 100,
-                    iters: int = 10):
+
+def _bench_classify(runtime, batch: int = CLASSIFY_BATCH,
+                    text_len: int = 100, iters: int = CLASSIFY_ITERS):
     from agent_tpu.ops import get_op
     from agent_tpu.runtime.context import OpContext
 
@@ -38,7 +48,7 @@ def _bench_classify(runtime, batch: int = 8192, text_len: int = 100,
     # Best of two measurement windows: the transport to the chip adds
     # load-dependent noise; the better window reflects the framework.
     best_rows_per_sec, best_p50 = 0.0, 0.0
-    for _ in range(2):
+    for _ in range(CLASSIFY_WINDOWS):
         lat = []
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -55,7 +65,8 @@ def _bench_classify(runtime, batch: int = 8192, text_len: int = 100,
     return best_rows_per_sec, best_p50
 
 
-def _bench_summarize(runtime, batch: int = 256, max_new: int = 32):
+def _bench_summarize(runtime, batch: int = SUMMARIZE_BATCH,
+                     max_new: int = SUMMARIZE_MAX_NEW):
     from agent_tpu.ops import get_op
     from agent_tpu.runtime.context import OpContext
 
@@ -89,7 +100,8 @@ def _bench_csv_index(tmpdir: str, n_rows: int = 200_000):
     return size_mb / dt
 
 
-def _bench_drain(runtime, n_rows: int = 65_536, shard_size: int = 8192):
+def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
+                 shard_size: int = CLASSIFY_BATCH):
     """Framework-level drain: controller shards a CSV into classify tasks,
     one agent drains them over real HTTP — the BASELINE.json 10M-row drain
     shape at bench scale. Returns end-to-end rows/sec."""
@@ -191,9 +203,12 @@ def main() -> int:
                 # Measurement config rides with the numbers so trend readers
                 # can tell workload changes from framework changes.
                 "bench_params": {
-                    "classify_batch": 8192, "classify_iters": 10,
-                    "classify_windows": 2, "summarize_batch": 256,
-                    "summarize_max_new": 32, "drain_rows": 65_536,
+                    "classify_batch": CLASSIFY_BATCH,
+                    "classify_iters": CLASSIFY_ITERS,
+                    "classify_windows": CLASSIFY_WINDOWS,
+                    "summarize_batch": SUMMARIZE_BATCH,
+                    "summarize_max_new": SUMMARIZE_MAX_NEW,
+                    "drain_rows": DRAIN_ROWS,
                 },
                 "metric": "map_classify_tpu rows/sec/chip",
                 "value": round(rows_per_sec_per_chip, 1),

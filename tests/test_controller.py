@@ -142,3 +142,90 @@ def test_submit_csv_job_rejects_nonpositive_total_rows():
         c.submit_csv_job("d.csv", total_rows=0, shard_size=100,
                          reduce_op="risk_accumulate")
     assert c.counts() == {}  # nothing half-submitted
+
+
+class TestLabelScheduling:
+    """required_labels gate leasing on the AGENT_LABELS channel the protocol
+    has always carried (reference app.py:49-63,168) but never consumed."""
+
+    def test_job_waits_for_matching_labels(self):
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        c.submit("echo", {"x": 1}, required_labels={"zone": "eu", "tpu": True})
+        # Wrong zone → nothing leased.
+        assert c.lease("a1", {"ops": ["echo"]},
+                       labels={"zone": "us", "tpu": True}) is None
+        # Missing tpu label → nothing.
+        assert c.lease("a2", {"ops": ["echo"]}, labels={"zone": "eu"}) is None
+        # Bare-token truthy label satisfies a True requirement; zone matches.
+        lease = c.lease("a3", {"ops": ["echo"]},
+                        labels={"zone": "eu", "tpu": True})
+        assert lease is not None and len(lease["tasks"]) == 1
+
+    def test_unlabeled_jobs_lease_to_anyone(self):
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        c.submit("echo", {})
+        assert c.lease("a", {"ops": ["echo"]}) is not None
+
+    def test_labels_flow_over_http(self):
+        import json
+        import urllib.request
+
+        from agent_tpu.controller.server import ControllerServer
+
+        with ControllerServer() as srv:
+            def post(path, body):
+                req = urllib.request.Request(
+                    srv.url + path, data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                resp = urllib.request.urlopen(req)
+                raw = resp.read()
+                return resp.status, (json.loads(raw) if raw else None)
+
+            post("/v1/jobs", {"op": "echo", "payload": {},
+                              "required_labels": {"pool": "batch"}})
+            # 204 for a non-matching agent...
+            status, _ = post("/v1/leases", {"agent": "x",
+                                            "capabilities": {"ops": ["echo"]},
+                                            "labels": {"pool": "realtime"}})
+            assert status == 204
+            # ...200 with the task for a matching one.
+            status, body = post("/v1/leases", {"agent": "y",
+                                               "capabilities": {"ops": ["echo"]},
+                                               "labels": {"pool": "batch"}})
+            assert status == 200 and len(body["tasks"]) == 1
+
+    def test_falsy_advertised_value_does_not_satisfy_true_requirement(self):
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        c.submit("echo", {}, required_labels={"tpu": True})
+        assert c.lease("a", {"ops": ["echo"]}, labels={"tpu": False}) is None
+        assert c.lease("b", {"ops": ["echo"]}, labels={"tpu": ""}) is None
+        assert c.lease("c", {"ops": ["echo"]}, labels={"tpu": True}) is not None
+
+    def test_numeric_requirement_matches_env_string_label(self):
+        """AGENT_LABELS only produces strings; a JSON-number requirement must
+        still match (string-coerced compare), not starve silently."""
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        c.submit("echo", {}, required_labels={"mem_gb": 16})
+        assert c.lease("a", {"ops": ["echo"]}, labels={"mem_gb": "16"}) is not None
+
+    def test_csv_job_carries_required_labels(self):
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        shard_ids, reduce_id = c.submit_csv_job(
+            "d.csv", total_rows=200, shard_size=100,
+            reduce_op="risk_accumulate", required_labels={"zone": "eu"})
+        assert c.lease("us", {"ops": ["read_csv_shard"]},
+                       labels={"zone": "us"}) is None
+        lease = c.lease("eu", {"ops": ["read_csv_shard"]},
+                        labels={"zone": "eu"}, max_tasks=2)
+        assert lease is not None and len(lease["tasks"]) == 2
+        assert c.job(reduce_id).required_labels == {"zone": "eu"}
