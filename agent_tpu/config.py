@@ -216,7 +216,11 @@ class OpsConfig:
     """Per-op knobs (reference ``ops/map_summarize.py:9-10``, trigger envs)."""
 
     summarize_model: str = "t5-small-swarm"   # BART_MODEL slot in the reference
-    summarize_force_cpu: bool = True          # SUMMARIZE_FORCE_CPU default on, ref :10
+    # Deliberate inversion of the reference default (ref :10 was CPU-on):
+    # BASELINE.json's north star is zero CPU-side model execution, so the
+    # kill-switch defaults OFF. The op reads this field (through ctx.config
+    # or OpsConfig.from_env), so this is the single source of the default.
+    summarize_force_cpu: bool = False         # SUMMARIZE_FORCE_CPU
     sap_host: Optional[str] = None
     sap_user: Optional[str] = None
     sap_pass: Optional[str] = None
@@ -228,7 +232,7 @@ class OpsConfig:
     def from_env() -> "OpsConfig":
         return OpsConfig(
             summarize_model=env_str("BART_MODEL", "t5-small-swarm"),
-            summarize_force_cpu=env_bool("SUMMARIZE_FORCE_CPU", True),
+            summarize_force_cpu=env_bool("SUMMARIZE_FORCE_CPU", False),
             sap_host=os.environ.get("SAP_HOST") or None,
             sap_user=os.environ.get("SAP_USER") or None,
             sap_pass=os.environ.get("SAP_PASS") or None,

@@ -38,6 +38,15 @@ SUCCEEDED = "succeeded"
 FAILED = "failed"
 
 
+def _truthy(value: Any) -> bool:
+    """Truthiness for advertised label values, consistent with the env
+    grammar (``config.env_bool``): AGENT_LABELS="tpu=false" advertises the
+    *string* "false", which must not satisfy a True requirement."""
+    if isinstance(value, str):
+        return value.strip().lower() in ("1", "true", "yes", "on", "y")
+    return bool(value)
+
+
 @dataclass
 class Job:
     job_id: str
@@ -94,12 +103,26 @@ class Controller:
         required_labels: Optional[Dict[str, Any]] = None,
     ) -> str:
         job_id = job_id or f"job-{uuid.uuid4().hex[:12]}"
+        required_labels = dict(required_labels or {})
+        for k, v in required_labels.items():
+            # Non-scalar requirements can never match the AGENT_LABELS
+            # grammar (strings or True) — rejecting here turns would-be
+            # silent starvation into an immediate submit error.
+            if not isinstance(k, str) or not k:
+                raise ValueError(f"required_labels keys must be strings, got {k!r}")
+            scalar_ok = v is True or (
+                isinstance(v, (str, int, float)) and not isinstance(v, bool)
+            )
+            if not scalar_ok:
+                raise ValueError(
+                    f"required_labels[{k!r}] must be True or a scalar, got {v!r}"
+                )
         job = Job(
             job_id=job_id,
             op=op,
             payload=payload or {},
             after=set(after or ()),
-            required_labels=dict(required_labels or {}),
+            required_labels=required_labels,
         )
         with self._lock:
             if job_id in self._jobs:
@@ -204,7 +227,7 @@ class Controller:
         for key, want in job.required_labels.items():
             have = labels.get(key)
             if want is True:
-                if not have:  # absent or falsy (False/""/0) → not satisfied
+                if not _truthy(have):  # absent, falsy, or "false"/"0"/...
                     return False
             elif have is None or str(have) != str(want):
                 return False

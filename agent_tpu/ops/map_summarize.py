@@ -155,14 +155,21 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     cfg = _get_cfg(payload)
     max_new = min(max_new, cfg.max_tgt_len)
 
-    from agent_tpu.config import env_bool
+    from agent_tpu.config import OpsConfig
 
     # stage = payload → texts (incl. shard read); runtime acquisition and
     # beyond is device time — same attribution as map_classify_tpu so the
     # shared timings schema means one thing across ops.
     t_staged = time.perf_counter()
 
-    if env_bool("SUMMARIZE_FORCE_CPU", False):
+    # The typed config is authoritative (its default is the single source;
+    # standalone calls read the env through OpsConfig.from_env).
+    ops_cfg = (
+        ctx.config.ops
+        if ctx is not None and getattr(ctx, "config", None) is not None
+        else OpsConfig.from_env()
+    )
+    if ops_cfg.summarize_force_cpu:
         from agent_tpu.ops.map_classify_tpu import _get_cpu_runtime
 
         runtime = _get_cpu_runtime()

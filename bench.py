@@ -27,6 +27,7 @@ CLASSIFY_WINDOWS = 2
 SUMMARIZE_BATCH = 256
 SUMMARIZE_MAX_NEW = 32
 DRAIN_ROWS = 65_536
+DRAIN_SHARD_SIZE = 8192
 
 
 def _bench_classify(runtime, batch: int = CLASSIFY_BATCH,
@@ -101,7 +102,7 @@ def _bench_csv_index(tmpdir: str, n_rows: int = 200_000):
 
 
 def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
-                 shard_size: int = CLASSIFY_BATCH):
+                 shard_size: int = DRAIN_SHARD_SIZE):
     """Framework-level drain: controller shards a CSV into classify tasks,
     one agent drains them over real HTTP — the BASELINE.json 10M-row drain
     shape at bench scale. Returns end-to-end rows/sec."""
@@ -209,6 +210,7 @@ def main() -> int:
                     "summarize_batch": SUMMARIZE_BATCH,
                     "summarize_max_new": SUMMARIZE_MAX_NEW,
                     "drain_rows": DRAIN_ROWS,
+                    "drain_shard_size": DRAIN_SHARD_SIZE,
                 },
                 "metric": "map_classify_tpu rows/sec/chip",
                 "value": round(rows_per_sec_per_chip, 1),

@@ -229,3 +229,26 @@ class TestLabelScheduling:
                         labels={"zone": "eu"}, max_tasks=2)
         assert lease is not None and len(lease["tasks"]) == 2
         assert c.job(reduce_id).required_labels == {"zone": "eu"}
+
+    def test_string_false_label_does_not_satisfy_true_requirement(self):
+        """AGENT_LABELS='tpu=false' advertises the STRING 'false' — it must
+        not satisfy a True requirement (env_bool-consistent truthiness)."""
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        c.submit("echo", {}, required_labels={"tpu": True})
+        assert c.lease("a", {"ops": ["echo"]}, labels={"tpu": "false"}) is None
+        assert c.lease("b", {"ops": ["echo"]}, labels={"tpu": "0"}) is None
+        assert c.lease("c", {"ops": ["echo"]}, labels={"tpu": "yes"}) is not None
+
+    def test_non_scalar_required_labels_rejected_at_submit(self):
+        import pytest as _pytest
+
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        with _pytest.raises(ValueError):
+            c.submit("echo", {}, required_labels={"zone": ["eu"]})
+        with _pytest.raises(ValueError):
+            c.submit("echo", {}, required_labels={"ok": False})
+        assert c.counts() == {}
