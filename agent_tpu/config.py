@@ -42,12 +42,18 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+# Truthy string tokens of the env/label grammar (reference
+# worker_sizing.py:31-41) — shared by env_bool and controller label matching
+# so the two can never diverge.
+TRUTHY_TOKENS = ("1", "true", "yes", "on", "y")
+
+
 def env_bool(name: str, default: bool) -> bool:
     """Truthy strings per reference worker_sizing.py:31-41 ("1", "true", "yes", "on")."""
     v = os.environ.get(name)
     if v is None or v == "":
         return default
-    return v.strip().lower() in ("1", "true", "yes", "on", "y")
+    return v.strip().lower() in TRUTHY_TOKENS
 
 
 def parse_labels(raw: str) -> Dict[str, Any]:

@@ -30,7 +30,7 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 PENDING = "pending"
 LEASED = "leased"
@@ -43,7 +43,9 @@ def _truthy(value: Any) -> bool:
     grammar (``config.env_bool``): AGENT_LABELS="tpu=false" advertises the
     *string* "false", which must not satisfy a True requirement."""
     if isinstance(value, str):
-        return value.strip().lower() in ("1", "true", "yes", "on", "y")
+        from agent_tpu.config import TRUTHY_TOKENS
+
+        return value.strip().lower() in TRUTHY_TOKENS
     return bool(value)
 
 
@@ -60,8 +62,12 @@ class Job:
     lease_deadline: float = 0.0
     agent: Optional[str] = None
     attempts: int = 0
-    # Jobs that must complete before this one becomes leasable (reduce stages).
+    # Jobs that must complete before this one becomes leasable (reduce
+    # stages). ``after_order`` preserves submission order for partials
+    # materialization (shard-10 must not precede shard-2); ``after`` is the
+    # same ids as a set for O(1) dependency checks.
     after: Set[str] = field(default_factory=set)
+    after_order: Tuple[str, ...] = ()
     # Label constraints: every key must appear in the leasing agent's labels,
     # and non-True values must match (the consumer side of the AGENT_LABELS
     # channel the protocol has always carried, reference app.py:49-63,168).
@@ -99,7 +105,7 @@ class Controller:
         op: str,
         payload: Optional[Dict[str, Any]] = None,
         job_id: Optional[str] = None,
-        after: Optional[Set[str]] = None,
+        after: Optional[Sequence[str]] = None,
         required_labels: Optional[Dict[str, Any]] = None,
     ) -> str:
         job_id = job_id or f"job-{uuid.uuid4().hex[:12]}"
@@ -117,11 +123,13 @@ class Controller:
                 raise ValueError(
                     f"required_labels[{k!r}] must be True or a scalar, got {v!r}"
                 )
+        after_order = tuple(after or ())
         job = Job(
             job_id=job_id,
             op=op,
             payload=payload or {},
-            after=set(after or ()),
+            after=set(after_order),
+            after_order=after_order,
             required_labels=required_labels,
         )
         with self._lock:
@@ -141,11 +149,18 @@ class Controller:
         reduce_op: Optional[str] = None,
         reduce_payload: Optional[Dict[str, Any]] = None,
         required_labels: Optional[Dict[str, Any]] = None,
+        collect_partials: bool = False,
     ) -> Tuple[List[str], Optional[str]]:
         """Split a CSV dataset into shard tasks (+ optional gated reduce job).
 
         Shards address rows ``[start_row, start_row + shard_size)`` — idempotent
         re-execution is the resume unit (SURVEY.md §5.4).
+
+        With ``collect_partials`` the controller materializes the shard jobs'
+        results into the reduce job's ``partials`` payload when it leases —
+        the "partials combined controller-side" flow the reference implied
+        (SURVEY.md §5.8) made explicit, e.g. ``map_op="risk_accumulate"``
+        (per-shard stats) + ``reduce_op="risk_accumulate"`` (merge).
         """
         if shard_size <= 0:
             raise ValueError("shard_size must be positive")
@@ -171,10 +186,13 @@ class Controller:
             )
         reduce_id = None
         if reduce_op is not None:
+            payload = dict(reduce_payload or {})
+            if collect_partials:
+                payload["__collect_partials__"] = True
             reduce_id = self.submit(
                 reduce_op,
-                dict(reduce_payload or {}),
-                after=set(shard_ids),
+                payload,
+                after=shard_ids,  # ordered: partials materialize shard-order
                 required_labels=required_labels,
             )
         return shard_ids, reduce_id
@@ -275,6 +293,17 @@ class Controller:
                     job.lease_deadline = deadline
                     job.agent = agent
                     job.attempts += 1
+                    if job.payload.pop("__collect_partials__", None):
+                        # Reduce-time materialization: dependency results
+                        # become the op's partials (kept out of the payload
+                        # until every shard result actually exists), in
+                        # submission order — shard order, for reduce ops
+                        # that are order-sensitive.
+                        job.payload["partials"] = [
+                            self._jobs[d].result
+                            for d in job.after_order
+                            if d in self._jobs
+                        ]
                     tasks.append(job.to_task())
                     if duplicate:
                         # Same task handed out twice under one lease: the

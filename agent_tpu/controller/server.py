@@ -86,6 +86,7 @@ class _Handler(BaseHTTPRequestHandler):
                         reduce_op=body.get("reduce_op"),
                         reduce_payload=body.get("reduce_payload"),
                         required_labels=body.get("required_labels"),
+                        collect_partials=bool(body.get("collect_partials")),
                     )
                     self._send(200, {"job_ids": shard_ids, "reduce_id": reduce_id})
                 else:

@@ -28,7 +28,89 @@ from agent_tpu.utils.errors import bad_input
 DEVICE_THRESHOLD = 4096
 
 
+def _merge_partials(payload: Dict[str, Any], t0: float) -> Dict[str, Any]:
+    """Merge per-shard stat partials — the reduce stage of a map-reduce drain.
+
+    ``partials`` is a list of prior risk_accumulate results (count/sum/min/
+    max); the controller materializes them from the shard jobs' results when
+    a reduce job submitted with ``collect_partials`` leases.
+    """
+    partials = payload["partials"]
+    if not isinstance(partials, list):
+        raise ValueError("partials must be a list of stat dicts")
+    count = 0
+    total = 0.0
+    mn: Optional[float] = None
+    mx: Optional[float] = None
+    for i, p in enumerate(partials):
+        if isinstance(p, dict) and p.get("ok") is False:
+            # A soft-failed shard slipped through as a SUCCEEDED dep — its
+            # rows are missing, so the reduce must FAIL visibly (RuntimeError
+            # → failed result) and surface the shard's own error, not a
+            # schema complaint about the error dict.
+            raise RuntimeError(
+                f"partial #{i} is a failed shard result: {p.get('error')!r}"
+            )
+        c = p.get("count") if isinstance(p, dict) else None
+        if isinstance(c, bool) or not isinstance(c, int) or c < 0:
+            raise ValueError(
+                "each partial needs a non-negative integer 'count' (+sum/min/max)"
+            )
+        if c == 0:
+            continue
+        for key in ("sum", "min", "max"):
+            v = p.get(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(f"each non-empty partial needs numeric {key!r}")
+        count += c
+        total += float(p["sum"])
+        v = float(p["min"])
+        mn = v if mn is None else min(mn, v)
+        v = float(p["max"])
+        mx = v if mx is None else max(mx, v)
+    if count == 0:
+        return _zero_result(t0)
+    return {
+        "ok": True,
+        "count": count,
+        "sum": total,
+        "mean": total / count,
+        "min": mn,
+        "max": mx,
+        "n_partials": len(partials),
+        "compute_time_ms": (time.perf_counter() - t0) * 1000.0,
+    }
+
+
 def _extract_values(payload: Dict[str, Any]) -> List[float]:
+    if "source_uri" in payload:
+        # CSV shard addressing: stats over a numeric column of the shard —
+        # risk_accumulate as the *map* stage of a map-reduce drain. Same loud
+        # failure semantics as the other drain-mode ops (RuntimeError/OSError
+        # propagate → shard FAILS and retries).
+        from agent_tpu.data.csv_index import read_shard, resolve_shard_payload
+
+        fieldname = payload.get("field", "risk")
+        if not isinstance(fieldname, str) or not fieldname:
+            raise ValueError("field must be a non-empty string")
+        path, start_row, shard_size = resolve_shard_payload(payload)
+        rows = read_shard(path, start_row, shard_size)
+        if not rows:
+            raise RuntimeError(
+                f"shard [{start_row}, {start_row + shard_size}) of {path!r} is empty"
+            )
+        out = []
+        for r in rows:
+            raw = r.get(fieldname)
+            if raw is None:
+                raise RuntimeError(f"column {fieldname!r} missing from {path!r}")
+            try:
+                out.append(float(raw))
+            except ValueError as exc:
+                raise RuntimeError(
+                    f"non-numeric {fieldname!r} value {raw!r} in {path!r}"
+                ) from exc
+        return out
     if "values" in payload:
         values = payload["values"]
         if not isinstance(values, list):
@@ -80,6 +162,12 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     threshold = payload.get("device_threshold", DEVICE_THRESHOLD)
     if isinstance(threshold, bool) or not isinstance(threshold, (int, float)) or threshold <= 0:
         return bad_input("device_threshold must be a positive number")
+
+    if "partials" in payload:
+        try:
+            return _merge_partials(payload, t0)
+        except ValueError as exc:
+            return bad_input(str(exc))
 
     try:
         values = _extract_values(payload)

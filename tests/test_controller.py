@@ -252,3 +252,47 @@ class TestLabelScheduling:
         with _pytest.raises(ValueError):
             c.submit("echo", {}, required_labels={"ok": False})
         assert c.counts() == {}
+
+
+class TestCollectPartials:
+    def test_partials_materialize_in_shard_order(self):
+        """shard-10 must not precede shard-2 (lexicographic trap) — partials
+        arrive in submission order."""
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        shard_ids, reduce_id = c.submit_csv_job(
+            "d.csv", total_rows=1200, shard_size=100,
+            reduce_op="risk_accumulate", collect_partials=True)
+        assert len(shard_ids) == 12
+        # Complete every shard with a result tagging its index.
+        for i, sid in enumerate(shard_ids):
+            lease = c.lease("a", {"ops": ["read_csv_shard"]})
+            for task in lease["tasks"]:
+                c.report(lease["lease_id"], task["id"], task["job_epoch"],
+                         "succeeded", result={"ok": True, "shard": None})
+        for i, sid in enumerate(shard_ids):
+            c._jobs[sid].result = {"ok": True, "shard": i}
+        lease = c.lease("a", {"ops": ["risk_accumulate"]})
+        (task,) = lease["tasks"]
+        assert task["id"] == reduce_id
+        assert [p["shard"] for p in task["payload"]["partials"]] == list(range(12))
+
+    def test_failed_shard_partial_fails_reduce_loudly(self):
+        import pytest as _pytest
+
+        from agent_tpu.ops import get_op
+
+        run = get_op("risk_accumulate")
+        with _pytest.raises(RuntimeError) as ei:
+            run({"partials": [{"ok": False, "error": "field must be a string"}]})
+        assert "field must be a string" in str(ei.value)
+
+    def test_bool_and_negative_counts_rejected(self):
+        from agent_tpu.ops import get_op
+
+        run = get_op("risk_accumulate")
+        assert run({"partials": [{"count": True, "sum": 1.0, "min": 1.0,
+                                  "max": 1.0}]})["ok"] is False
+        assert run({"partials": [{"count": -5, "sum": 1.0, "min": 1.0,
+                                  "max": 1.0}]})["ok"] is False

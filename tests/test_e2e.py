@@ -139,3 +139,31 @@ def test_agent_ships_dynamic_worker_profile(big_csv):
         assert prof["cpu"]["logical_cores"] >= 1
         assert "tpu" in prof and "limits" in prof
         assert prof["limits"]["max_payload_bytes"] == 262144
+
+
+def test_full_map_reduce_drain_with_partials(big_csv):
+    """The complete map-reduce story: risk_accumulate as the per-shard map
+    stage over the CSV's risk column, the controller materializing shard
+    partials into the reduce job, and the merged stats equal to a
+    whole-column pass — all over real HTTP."""
+    controller = Controller()
+    with ControllerServer(controller) as server:
+        shard_ids, reduce_id = controller.submit_csv_job(
+            big_csv, total_rows=1000, shard_size=100,
+            map_op="risk_accumulate",
+            extra_payload={"field": "risk"},
+            reduce_op="risk_accumulate",
+            collect_partials=True,
+        )
+        agent = make_agent(server.url, ["risk_accumulate"])
+        assert drain(agent, controller)
+
+        final = controller.job(reduce_id).result
+        values = [(i % 17) * 0.25 for i in range(1000)]
+        assert final["count"] == 1000
+        assert abs(final["sum"] - sum(values)) < 1e-6
+        assert final["min"] == min(values) and final["max"] == max(values)
+        assert final["n_partials"] == 10
+        # Each shard computed real partials, not raw row echoes.
+        shard0 = controller.job(shard_ids[0]).result
+        assert shard0["count"] == 100 and "sum" in shard0

@@ -167,3 +167,40 @@ def test_map_tokenize_chars_reference_wire_contract():
     assert multi["items_count"] == 2
     assert multi["total_chars"] == 6
     assert multi["count"] == len(multi["tokens"])
+
+
+class TestRiskAccumulateMapReduce:
+    def test_source_uri_map_stage(self, tmp_csv):
+        from agent_tpu.ops import get_op
+
+        run = get_op("risk_accumulate")
+        out = run({"source_uri": tmp_csv, "start_row": 0, "shard_size": 10,
+                   "field": "risk"})
+        want = [i * 0.5 for i in range(10)]
+        assert out["ok"] and out["count"] == 10
+        assert abs(out["sum"] - sum(want)) < 1e-9
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError):
+            run({"source_uri": tmp_csv, "start_row": 10_000})
+        with _pytest.raises(RuntimeError):
+            run({"source_uri": tmp_csv, "field": "text"})  # non-numeric
+
+    def test_partials_merge(self):
+        from agent_tpu.ops import get_op
+
+        run = get_op("risk_accumulate")
+        p1 = run({"values": [1.0, 2.0, 3.0]})
+        p2 = run({"values": [10.0, -5.0]})
+        p3 = run({"values": []})
+        merged = run({"partials": [p1, p2, p3]})
+        assert merged["count"] == 5
+        assert abs(merged["sum"] - 11.0) < 1e-9
+        assert merged["min"] == -5.0 and merged["max"] == 10.0
+        assert merged["n_partials"] == 3
+        # All-empty partials → zero shape.
+        zero = run({"partials": [p3]})
+        assert zero["count"] == 0 and zero["min"] is None
+        # Malformed partials → soft error.
+        bad = run({"partials": [{"count": "x"}]})
+        assert bad["ok"] is False
