@@ -32,6 +32,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
+from agent_tpu.config import TRUTHY_TOKENS
+
 PENDING = "pending"
 LEASED = "leased"
 SUCCEEDED = "succeeded"
@@ -43,8 +45,6 @@ def _truthy(value: Any) -> bool:
     grammar (``config.env_bool``): AGENT_LABELS="tpu=false" advertises the
     *string* "false", which must not satisfy a True requirement."""
     if isinstance(value, str):
-        from agent_tpu.config import TRUTHY_TOKENS
-
         return value.strip().lower() in TRUTHY_TOKENS
     return bool(value)
 
@@ -343,9 +343,11 @@ class Controller:
             if job.state == SUCCEEDED:
                 # Duplicate completion (e.g. duplicate_task fault): first wins.
                 return {"accepted": False, "reason": "already complete"}
-            job.state = SUCCEEDED if status == "succeeded" else FAILED
+            # result/error before state: unlocked readers keying on a
+            # terminal state must never see it paired with a stale result.
             job.result = result
             job.error = error
+            job.state = SUCCEEDED if status == "succeeded" else FAILED
             job.lease_id = lease_id
             if job.state == FAILED:
                 # Failed jobs are re-queued once more before sticking failed —
@@ -361,6 +363,23 @@ class Controller:
     def job(self, job_id: str) -> Job:
         with self._lock:
             return self._jobs[job_id]
+
+    def job_snapshot(self, job_id: str) -> Dict[str, Any]:
+        """Consistent read of a job's public fields (all under one lock —
+        a field-by-field read could observe state='succeeded' before the
+        result assignment lands). The HTTP GET surface uses this."""
+        with self._lock:
+            job = self._jobs[job_id]
+            return {
+                "job_id": job.job_id,
+                "op": job.op,
+                "state": job.state,
+                "job_epoch": job.epoch,
+                "attempts": job.attempts,
+                "agent": job.agent,
+                "result": job.result,
+                "error": job.error,
+            }
 
     def counts(self) -> Dict[str, int]:
         with self._lock:

@@ -112,6 +112,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "last_metrics": self.controller.last_metrics,
                 },
             )
+        elif self.path.startswith("/v1/jobs/"):
+            job_id = self.path[len("/v1/jobs/"):]
+            try:
+                self._send(200, self.controller.job_snapshot(job_id))
+            except KeyError:
+                self._send(404, {"error": f"unknown job {job_id!r}"})
         else:
             self._send(404, {"error": f"no route {self.path}"})
 

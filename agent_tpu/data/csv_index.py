@@ -180,9 +180,15 @@ def count_rows(path: str) -> int:
     return CsvIndex.for_file(path).n_data_rows
 
 
-def read_shard_texts(payload: Dict, default_field: str = "text") -> List[str]:
-    """Shard-addressed payload → the shard's text column, for drain-mode ops
-    (classify and summarize must treat the same CSV identically).
+def read_shard_column(
+    payload: Dict, field_payload_key: str, default_field: str
+) -> List[str]:
+    """Shard-addressed payload → one column of the shard, for drain-mode ops
+    (classify, summarize, and risk_accumulate must treat the same CSV
+    identically).
+
+    ``field_payload_key`` names the payload key that selects the column
+    (``"text_field"`` for the text ops, ``"field"`` for risk_accumulate).
 
     Error contract: malformed payload keys raise ValueError (deterministic
     caller error → soft ``bad_input``); shard-level integrity problems (empty
@@ -190,9 +196,9 @@ def read_shard_texts(payload: Dict, default_field: str = "text") -> List[str]:
     both must surface as *failed* task results so the controller retries and
     then visibly fails, never as soft results that drop the shard's rows.
     """
-    field = payload.get("text_field", default_field)
+    field = payload.get(field_payload_key, default_field)
     if not isinstance(field, str) or not field:
-        raise ValueError("text_field must be a non-empty string")
+        raise ValueError(f"{field_payload_key} must be a non-empty string")
     path, start_row, shard_size = resolve_shard_payload(payload)
     rows = read_shard(path, start_row, shard_size)
     if not rows:
@@ -205,3 +211,8 @@ def read_shard_texts(payload: Dict, default_field: str = "text") -> List[str]:
             f"column {field!r} missing from {missing} rows of {path!r}"
         )
     return [r[field] for r in rows]
+
+
+def read_shard_texts(payload: Dict, default_field: str = "text") -> List[str]:
+    """The text-op flavor of :func:`read_shard_column` (``text_field`` key)."""
+    return read_shard_column(payload, "text_field", default_field)

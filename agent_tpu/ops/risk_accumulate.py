@@ -69,7 +69,9 @@ def _merge_partials(payload: Dict[str, Any], t0: float) -> Dict[str, Any]:
         v = float(p["max"])
         mx = v if mx is None else max(mx, v)
     if count == 0:
-        return _zero_result(t0)
+        out = _zero_result(t0)
+        out["n_partials"] = len(partials)  # same schema as non-empty merges
+        return out
     return {
         "ok": True,
         "count": count,
@@ -85,30 +87,20 @@ def _merge_partials(payload: Dict[str, Any], t0: float) -> Dict[str, Any]:
 def _extract_values(payload: Dict[str, Any]) -> List[float]:
     if "source_uri" in payload:
         # CSV shard addressing: stats over a numeric column of the shard —
-        # risk_accumulate as the *map* stage of a map-reduce drain. Same loud
-        # failure semantics as the other drain-mode ops (RuntimeError/OSError
-        # propagate → shard FAILS and retries).
-        from agent_tpu.data.csv_index import read_shard, resolve_shard_payload
+        # risk_accumulate as the *map* stage of a map-reduce drain. Shared
+        # shard-reading contract with the text ops (read_shard_column):
+        # RuntimeError/OSError propagate → the shard FAILS and retries.
+        from agent_tpu.data.csv_index import read_shard_column
 
-        fieldname = payload.get("field", "risk")
-        if not isinstance(fieldname, str) or not fieldname:
-            raise ValueError("field must be a non-empty string")
-        path, start_row, shard_size = resolve_shard_payload(payload)
-        rows = read_shard(path, start_row, shard_size)
-        if not rows:
-            raise RuntimeError(
-                f"shard [{start_row}, {start_row + shard_size}) of {path!r} is empty"
-            )
+        raw_values = read_shard_column(payload, "field", "risk")
         out = []
-        for r in rows:
-            raw = r.get(fieldname)
-            if raw is None:
-                raise RuntimeError(f"column {fieldname!r} missing from {path!r}")
+        for raw in raw_values:
             try:
                 out.append(float(raw))
             except ValueError as exc:
                 raise RuntimeError(
-                    f"non-numeric {fieldname!r} value {raw!r} in {path!r}"
+                    f"non-numeric value {raw!r} in shard column "
+                    f"{payload.get('field', 'risk')!r}"
                 ) from exc
         return out
     if "values" in payload:

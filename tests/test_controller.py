@@ -296,3 +296,41 @@ class TestCollectPartials:
                                   "max": 1.0}]})["ok"] is False
         assert run({"partials": [{"count": -5, "sum": 1.0, "min": 1.0,
                                   "max": 1.0}]})["ok"] is False
+
+
+def test_http_job_result_retrieval():
+    """Operators submit over HTTP — they must be able to fetch results the
+    same way (GET /v1/jobs/<id>)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from agent_tpu.controller.server import ControllerServer
+
+    with ControllerServer() as srv:
+        req = urllib.request.Request(
+            srv.url + "/v1/jobs",
+            data=json.dumps({"op": "echo", "payload": {"x": 7}}).encode(),
+            headers={"Content-Type": "application/json"})
+        job_id = json.loads(urllib.request.urlopen(req).read())["job_id"]
+
+        with urllib.request.urlopen(srv.url + f"/v1/jobs/{job_id}") as r:
+            body = json.loads(r.read())
+        assert body["state"] == "pending" and body["op"] == "echo"
+
+        # Complete it via the lease/report wire path, then fetch the result.
+        lease = srv.controller.lease("a", {"ops": ["echo"]})
+        (task,) = lease["tasks"]
+        srv.controller.report(lease["lease_id"], task["id"],
+                              task["job_epoch"], "succeeded",
+                              result={"ok": True, "echo": {"x": 7}})
+        with urllib.request.urlopen(srv.url + f"/v1/jobs/{job_id}") as r:
+            body = json.loads(r.read())
+        assert body["state"] == "succeeded"
+        assert body["result"]["echo"] == {"x": 7}
+
+        try:
+            urllib.request.urlopen(srv.url + "/v1/jobs/nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 404
