@@ -188,33 +188,48 @@ def byte_encode_pad(
     buckets: Sequence[int] = DEFAULT_BUCKETS,
     batch_buckets: Optional[Sequence[int]] = None,
     max_len_cap: Optional[int] = None,
+    add_bos: bool = False,
+    add_eos: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Fused byte-tokenize + pad: texts → (ids[B, L] int32, lengths[B] int32).
 
     The hot-path replacement for ``ByteTokenizer.encode`` + ``pad_batch`` on
     large batches: each row is one ``np.frombuffer`` over the UTF-8 bytes
     (C speed) instead of a per-byte Python list — same ids (byte + N_SPECIAL),
-    same bucketed static shapes, same truncation. Returns per-row lengths
-    (not a mask): the device path rebuilds the mask from lengths on-chip.
+    same bucketed static shapes, same truncation semantics (BOS/EOS count
+    toward the cap, exactly like ``encode(add_bos, add_eos)[:cap]``). Returns
+    per-row lengths (not a mask): the device path rebuilds the mask on-chip.
     """
     cap = max_len_cap if max_len_cap is not None else buckets[-1]
-    bufs = [t.encode("utf-8")[:cap] for t in texts]
+    off = int(add_bos)
+    bufs = [t.encode("utf-8") for t in texts]
     rows = len(bufs)
-    max_len = max((len(b) for b in bufs), default=1)
-    L = bucket_length(max(1, min(max_len, cap)), buckets)
+    lens = np.fromiter((len(b) for b in bufs), dtype=np.int64, count=rows)
+    # Exactly encode(add_bos, add_eos)[:cap] then pad_batch: BOS/EOS join the
+    # stream before truncation (a too-long text loses its EOS), and rows
+    # truncate again to the top bucket when cap exceeds it (bucket_length's
+    # "callers truncate to it" contract).
+    totals = np.minimum(off + lens + int(add_eos), cap)
+    L = bucket_length(max(1, int(totals.max()) if rows else 1), buckets)
+    totals = np.minimum(totals, L)
     B = bucket_length(max(1, rows), batch_buckets) if batch_buckets else rows
     ids = np.zeros((B, L), dtype=np.int32)
     lengths = np.zeros(B, dtype=np.int32)
+    lengths[:rows] = totals
+    nb = np.zeros(B, dtype=np.int64)
     for r, b in enumerate(bufs):
-        n = min(len(b), L)
-        lengths[r] = n
+        n = min(len(b), int(totals[r]) - off) if totals[r] > off else 0
+        nb[r] = n
         if n:
-            ids[r, :n] = np.frombuffer(b, dtype=np.uint8, count=n)
-    ids[ids > 0] += N_SPECIAL
-    # Byte 0x00 maps to id N_SPECIAL too, but the += above skipped the zeros
-    # it wrote; fix the in-length zeros explicitly (rare: NUL bytes in text).
-    mask = np.arange(L)[None, :] < lengths[:, None]
-    ids[(ids == 0) & mask] = N_SPECIAL
+            ids[r, off : off + n] = np.frombuffer(b, dtype=np.uint8, count=n)
+    cols = np.arange(L)[None, :]
+    body = (cols >= off) & (cols < off + nb[:, None])
+    ids[body] += N_SPECIAL                     # every body byte, NULs included
+    if add_bos and rows:
+        ids[:rows, 0][totals > 0] = BOS_ID
+    if add_eos and rows:
+        fits = np.flatnonzero(off + lens + 1 <= np.minimum(cap, L))
+        ids[fits, (off + nb[fits]).astype(np.int64)] = EOS_ID
     return ids, lengths
 
 

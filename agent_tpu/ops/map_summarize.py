@@ -60,12 +60,14 @@ def _generate(runtime, texts: List[str], model_id: str, cfg,
     import jax
 
     from agent_tpu.models import seq2seq
-    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS, ByteTokenizer, pad_batch
+    from agent_tpu.models.tokenizer import (
+        DEFAULT_BUCKETS,
+        ByteTokenizer,
+        byte_encode_pad,
+    )
     from agent_tpu.ops._model_common import batch_buckets, cfg_key, iter_chunks
 
     tok = ByteTokenizer()
-    seqs = [tok.encode(t, add_bos=True, add_eos=True)[: cfg.max_src_len]
-            for t in texts]
     dp = runtime.axis_size("dp")
     # Length buckets must not exceed the position table (max_src_len).
     buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_src_len] or [cfg.max_src_len]
@@ -77,8 +79,15 @@ def _generate(runtime, texts: List[str], model_id: str, cfg,
     )
     summaries: List[str] = []
     attn_fn = runtime.attention_fn()  # ring over sp for the encoder pass
-    for chunk in iter_chunks(seqs, bbuckets[-1]):
-        ids, mask = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
+    for chunk in iter_chunks(texts, bbuckets[-1]):
+        # Fused tokenize+pad (one numpy pass per row, classify's hot path).
+        ids, lengths = byte_encode_pad(
+            chunk, buckets=buckets, batch_buckets=bbuckets,
+            max_len_cap=cfg.max_src_len, add_bos=True, add_eos=True,
+        )
+        mask = (np.arange(ids.shape[1])[None, :] < lengths[:, None]).astype(
+            np.int32
+        )
         B, Ls = ids.shape
         fn = runtime.compiled(
             ("map_summarize", model_id, B, Ls, max_new, num_beams, cfg_key(cfg)),

@@ -84,3 +84,45 @@ def test_byte_encode_pad_matches_encode_plus_pad():
     np.testing.assert_array_equal(
         got_lengths, want_mask.sum(axis=1).astype(np.int32)
     )
+
+
+def test_byte_encode_pad_bos_eos_matches_encode_plus_pad():
+    """BOS/EOS semantics must match encode(add_bos, add_eos)[:cap] exactly,
+    including the EOS lost to truncation at the cap boundary."""
+    import numpy as np
+
+    from agent_tpu.models.tokenizer import (
+        ByteTokenizer, byte_encode_pad, pad_batch,
+    )
+
+    tok = ByteTokenizer()
+    # 126/127/128 body bytes straddle the cap-128 boundary with bos+eos.
+    texts = ["hello", "", "y" * 126, "y" * 127, "y" * 128, "nul\x00b"]
+    seqs = [tok.encode(t, add_bos=True, add_eos=True)[:128] for t in texts]
+    want_ids, want_mask = pad_batch(seqs, buckets=[16, 64, 128],
+                                    batch_buckets=[8])
+    got_ids, got_lengths = byte_encode_pad(
+        texts, buckets=[16, 64, 128], batch_buckets=[8], max_len_cap=128,
+        add_bos=True, add_eos=True,
+    )
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_array_equal(
+        got_lengths, want_mask.sum(axis=1).astype(np.int32)
+    )
+
+
+def test_byte_encode_pad_cap_above_top_bucket_truncates():
+    """cap > largest bucket must truncate to the bucket (bucket_length's
+    'callers truncate to it' contract), not overflow the row write."""
+    import numpy as np
+
+    from agent_tpu.models.tokenizer import byte_encode_pad
+
+    ids, lengths = byte_encode_pad(["y" * 90], buckets=[16, 32, 64],
+                                   max_len_cap=100)
+    assert ids.shape[1] == 64 and lengths[0] == 64
+    ids, lengths = byte_encode_pad(["y" * 90], buckets=[16, 32, 64],
+                                   max_len_cap=100, add_bos=True, add_eos=True)
+    assert ids.shape[1] == 64 and lengths[0] == 64
+    assert ids[0, 0] == 1  # BOS survives; EOS lost to truncation
+    assert (ids[0] == 2).sum() == 0
