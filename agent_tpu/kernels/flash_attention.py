@@ -163,6 +163,112 @@ def flash_attention(
     )(q, k, v, mask3d)
 
 
+def _flash_fold_kernel(q_ref, k_ref, v_ref, mask_ref,
+                       m_in_ref, l_in_ref, acc_in_ref,
+                       m_out_ref, l_out_ref, acc_out_ref,
+                       m_scr, l_scr, acc_scr, *, scale: float, n_k: int):
+    """One flash pass over a K/V block with *carried* softmax state.
+
+    The ring-attention hop kernel: instead of zero-initializing (m, l, acc)
+    like :func:`_flash_kernel`, state streams in from the previous hop and
+    streams out updated — same per-tile fold math, composable across hops.
+    """
+    kb = pl.program_id(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.broadcast_to(m_in_ref[0, 0], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_in_ref[0, 0], l_scr.shape)
+        acc_scr[:] = acc_in_ref[0, 0]
+
+    s = jax.lax.dot_general(
+        q_ref[0, 0], k_ref[0, 0],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * scale
+    keep = mask_ref[0, 0, :][None, :] > 0
+    s = jnp.where(keep, s, NEG_INF)
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new) * keep
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0, 0],
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kb == n_k - 1)
+    def _emit():
+        m_out_ref[0, 0] = m_scr[:, :1]
+        l_out_ref[0, 0] = l_scr[:, :1]
+        acc_out_ref[0, 0] = acc_scr[:]
+
+
+def flash_fold_supported(q_shape, lk: int, *, block_q: int = 512,
+                         block_k: int = 512) -> bool:
+    """Static-shape gate for :func:`flash_fold` (per-hop blocks are already
+    short, so no min-length heuristic here — the caller chose the ring)."""
+    _, _, lq, _ = q_shape
+    bq = min(block_q, lq)
+    bk = min(block_k, lk)
+    return lq % bq == 0 and lk % bk == 0
+
+
+def flash_fold(q, k, v, mask, m, l, acc, *, block_q: int = 512,
+               block_k: int = 512, interpret: Optional[bool] = None,
+               vma=None):
+    """Fold K/V block ``k``/``v`` (key-padding ``mask`` [B, 1, 1, Lk]) into
+    streaming-softmax state ``(m, l, acc)`` → updated state. The Pallas form
+    of ``agent_tpu.parallel.ring``'s einsum fold — one fused VMEM pass.
+
+    ``vma``: varying-mesh-axes annotation for the outputs — required when
+    called inside a ``shard_map`` with vma checking (the ring passes its
+    mesh axes); leave None outside shard_map.
+    """
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    bq = min(block_q, Lq)
+    bk = min(block_k, Lk)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    mask3d = jnp.broadcast_to(mask[:, 0, :, :], (B, 1, Lk)).astype(jnp.int32)
+    n_q, n_k = Lq // bq, Lk // bk
+    kernel = functools.partial(
+        _flash_fold_kernel, scale=1.0 / np.sqrt(D), n_k=n_k
+    )
+    qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM)
+    sspec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            qspec, kspec, kspec,
+            pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, j),
+                         memory_space=pltpu.VMEM),
+            sspec, sspec, qspec,
+        ],
+        out_specs=(sspec, sspec, qspec),
+        out_shape=(
+            jax.ShapeDtypeStruct(m.shape, jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct(l.shape, jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct(acc.shape, jnp.float32, vma=vma),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask3d, m.astype(jnp.float32), l.astype(jnp.float32),
+      acc.astype(jnp.float32))
+
+
 def make_flash_attention(mesh):
     """Mesh-aware flash attention: the kernel wrapped in ``shard_map``.
 

@@ -85,28 +85,39 @@ def _generate(runtime, texts: List[str], model_id: str, cfg,
             chunk, buckets=buckets, batch_buckets=bbuckets,
             max_len_cap=cfg.max_src_len, add_bos=True, add_eos=True,
         )
-        mask = (np.arange(ids.shape[1])[None, :] < lengths[:, None]).astype(
-            np.int32
-        )
         B, Ls = ids.shape
+
+        # Lengths-on-wire like classify: ship uint16 ids + one length per
+        # row, rebuild ids dtype and the [B, L] mask inside the compiled
+        # program — ~4× less host→device traffic per chunk.
+        def build(Ls=Ls):
+            import jax.numpy as jnp
+
+            gen = (
+                (lambda p, i, m: seq2seq.greedy_generate(
+                    p, i, m, cfg, max_new, attn_fn=attn_fn))
+                if num_beams <= 1
+                else (lambda p, i, m: seq2seq.beam_generate(
+                    p, i, m, cfg, max_new, num_beams=num_beams,
+                    attn_fn=attn_fn))
+            )
+
+            def run_gen(p, i, n):
+                mask = (jnp.arange(Ls)[None, :] < n[:, None]).astype(jnp.int32)
+                return gen(p, i.astype(jnp.int32), mask)
+
+            return jax.jit(run_gen)
+
         fn = runtime.compiled(
             ("map_summarize", model_id, B, Ls, max_new, num_beams, cfg_key(cfg)),
-            lambda: jax.jit(
-                (
-                    lambda p, i, m: seq2seq.greedy_generate(
-                        p, i, m, cfg, max_new, attn_fn=attn_fn
-                    )
-                )
-                if num_beams <= 1
-                else (
-                    lambda p, i, m: seq2seq.beam_generate(
-                        p, i, m, cfg, max_new, num_beams=num_beams,
-                        attn_fn=attn_fn,
-                    )
-                )
-            ),
+            build,
         )
-        toks, _ = fn(params, runtime.put_batch(ids), runtime.put_batch(mask))
+        wire_dtype = np.uint16 if cfg.vocab_size <= (1 << 16) else np.int32
+        toks, _ = fn(
+            params,
+            runtime.put_batch(ids.astype(wire_dtype)),
+            runtime.put_batch(lengths),
+        )
         toks = np.asarray(toks)[: len(chunk)]
         summaries.extend(tok.decode([t for t in row if t > 0]) for row in toks)
     return summaries, runtime.platform

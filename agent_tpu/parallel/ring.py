@@ -43,16 +43,29 @@ from jax.sharding import Mesh, PartitionSpec as P
 from agent_tpu.models.layers import NEG_INF, dot_product_attention
 
 
-def _ring_local(q, k, v, mask, sp: int):
+def _ring_local(q, k, v, mask, sp: int, use_flash_fold: bool = False):
     """Per-device body: streaming-softmax attention over ``sp`` ring hops.
 
     q: [b, h, lq, d] (local Q block, f32-scaled below)
     k, v: [b, h, lk, d] (current K/V block, rotates)
     mask: [b, 1, 1, lk] key-padding block (1 = attend, rotates with K/V)
+
+    With ``use_flash_fold`` each hop's fold runs as the fused Pallas kernel
+    (``agent_tpu.kernels.flash_attention.flash_fold``) instead of einsums —
+    the ring schedules communication, the kernel does the math, closing the
+    sp>1-bypasses-the-kernel gap.
     """
     out_dtype = q.dtype
     scale = 1.0 / np.sqrt(q.shape[-1])
     qf = q.astype(jnp.float32) * scale
+
+    if use_flash_fold:
+        from agent_tpu.kernels.flash_attention import (
+            flash_fold,
+            flash_fold_supported,
+        )
+
+        use_flash_fold = flash_fold_supported(q.shape, k.shape[2])
 
     b, h, lq, _ = q.shape
     # Mark the zero-init carry device-varying: shard_map requires the scan
@@ -67,11 +80,15 @@ def _ring_local(q, k, v, mask, sp: int):
         """Fold one K/V block into the streaming softmax state.
 
         Same m/l/acc update as the Pallas flash kernel's per-tile fold
-        (``agent_tpu.kernels.flash_attention._flash_kernel``) — a numerics
-        change there must land here too. Composing the two (ring hops whose
-        local fold runs the fused kernel) is the open fast path for sp>1 on
-        real TPU.
+        (``agent_tpu.kernels.flash_attention._flash_fold_kernel``) — a
+        numerics change there must land here too; the einsum form is the
+        fallback when the kernel path is off or the shapes don't tile.
         """
+        if use_flash_fold:
+            return flash_fold(
+                q, k_blk, v_blk, m_blk, m, l, acc,
+                vma=frozenset({"dp", "tp", "sp"}),
+            )
         scores = jnp.einsum(
             "bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32)
         )
@@ -106,13 +123,16 @@ def _ring_local(q, k, v, mask, sp: int):
     return (acc / jnp.maximum(l, 1e-30)).astype(out_dtype)
 
 
-def make_ring_attention(mesh: Mesh):
+def make_ring_attention(mesh: Mesh, use_flash_fold: bool = None):
     """``attn_fn`` running ring attention over ``mesh``'s ``sp`` axis.
 
     With ``sp == 1`` (or shapes/mask the ring can't take) this is exactly
     :func:`~agent_tpu.models.layers.dot_product_attention` — same program,
     different mesh, preserving the framework's one-codepath rule
     (SURVEY.md §7: fallback is a backend/mesh switch, not a second model).
+
+    ``use_flash_fold`` (default: auto — on for real TPU) runs each hop's
+    local fold as the fused Pallas kernel.
     """
     shape = dict(mesh.shape)
     sp = shape.get("sp", 1)
@@ -120,9 +140,11 @@ def make_ring_attention(mesh: Mesh):
         return dot_product_attention
     dp = shape.get("dp", 1)
     tp = shape.get("tp", 1)
+    if use_flash_fold is None:
+        use_flash_fold = jax.default_backend() == "tpu"
 
     sharded = jax.shard_map(
-        partial(_ring_local, sp=sp),
+        partial(_ring_local, sp=sp, use_flash_fold=use_flash_fold),
         mesh=mesh,
         in_specs=(
             P("dp", "tp", "sp", None),   # q: heads over tp, Lq over sp
@@ -131,6 +153,12 @@ def make_ring_attention(mesh: Mesh):
             P("dp", None, None, "sp"),   # key-padding mask: Lk over sp
         ),
         out_specs=P("dp", "tp", "sp", None),
+        # The pallas INTERPRET-mode lowering emits dynamic_slices whose
+        # operands confuse the vma checker inside shard_map (jax suggests
+        # exactly this workaround). Scoped to interpret mode only: compiled
+        # TPU runs keep full varying-mesh-axes verification (the fold's
+        # outputs carry their vma annotation).
+        check_vma=not (use_flash_fold and jax.default_backend() != "tpu"),
     )
 
     def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,

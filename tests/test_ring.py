@@ -124,3 +124,19 @@ def test_encoder_forward_with_ring_matches_dense(rt):
     np.testing.assert_allclose(
         np.asarray(ring_logits), np.asarray(dense_logits), rtol=5e-5, atol=5e-5
     )
+
+
+def test_ring_with_flash_fold_matches_dense(rt):
+    """Ring hops folding through the Pallas kernel (interpret mode on the
+    CPU mesh) must equal dense attention — the ring schedules communication,
+    the kernel does the math."""
+    ring = make_ring_attention(rt.mesh, use_flash_fold=True)
+    q, k, v, mask = _qkvm()
+    got = np.asarray(ring(q, k, v, mask))
+    want = np.asarray(layers.dot_product_attention(q, k, v, mask))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    # Fully-masked row stays zero through the kernel path too.
+    mask0 = mask.at[1].set(0)
+    got0 = np.asarray(ring(q, k, v, mask0))
+    assert np.isfinite(got0).all()
+    np.testing.assert_array_equal(got0[1], np.zeros_like(got0[1]))
