@@ -140,7 +140,8 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
             controller.submit_csv_job(
                 path, total_rows=shard_size, shard_size=shard_size,
                 map_op="map_classify_tpu",
-                extra_payload={"text_field": "text", "allow_fallback": False},
+                extra_payload={"text_field": "text", "allow_fallback": False,
+                               "result_format": "columnar"},
             )
             while not controller.drained():
                 agent.step()
@@ -148,7 +149,8 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
             controller.submit_csv_job(
                 path, total_rows=n_rows, shard_size=shard_size,
                 map_op="map_classify_tpu",
-                extra_payload={"text_field": "text", "allow_fallback": False},
+                extra_payload={"text_field": "text", "allow_fallback": False,
+                               "result_format": "columnar"},
             )
             t0 = time.perf_counter()
             while not controller.drained():
