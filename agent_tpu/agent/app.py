@@ -234,8 +234,11 @@ class Agent:
         """Execute one leased task inline and report its result.
 
         Any raised exception becomes a ``failed`` result with the structured
-        ``{type, message, trace}`` error (reference ``app.py:288-294``); the
-        agent itself never dies on an op error.
+        ``{type, message, trace}`` error (reference ``app.py:288-294``); a
+        single-host agent never dies on an op error. Multi-host slices fail
+        in lockstep instead: leader and followers all re-raise (see
+        ``run_follower``), because continuing past a diverged collective
+        program would wedge the slice silently.
         """
         t0 = time.perf_counter()
         try:
@@ -279,6 +282,18 @@ class Agent:
             status = "failed"
             error = structured_error(exc)
             self.rate.log("exec", "op raised", op=op, type=type(exc).__name__)
+            if self.dist.process_count > 1:
+                # Multi-host, ops are collective programs: followers that hit
+                # the same exception crash (run_follower); a leader that
+                # caught it and moved on would re-enter the broadcast
+                # collective against dead or desynced peers — a silent slice
+                # hang. Post the structured failure (so the controller can
+                # stick the job failed after its one retry), then die in
+                # lockstep with the followers; the slice restarts clean.
+                self.post_result(
+                    lease_id, job_id, epoch, status, result=None, error=error
+                )
+                raise
         duration_ms = (time.perf_counter() - t0) * 1000.0
         if isinstance(result, dict):
             result.setdefault("duration_ms", duration_ms)
@@ -336,7 +351,13 @@ class Agent:
     def run_follower(self) -> None:
         """Non-leader hosts: execute every task the leader broadcasts, in
         lockstep, discarding results (the leader posts them). Blocks in the
-        broadcast collective between tasks; exits on the shutdown sentinel."""
+        broadcast collective between tasks; exits on the shutdown sentinel.
+
+        Drain-mode ops (``source_uri`` payloads) require the dataset path
+        readable on **every** host of the slice — a follower that fails to
+        read it host-locally never enters the SPMD program the leader is
+        already inside, which would wedge the whole slice in that collective.
+        """
         from agent_tpu.runtime.distributed import broadcast_task, is_shutdown
 
         log("follower up", process=self.dist.process_index)
@@ -357,8 +378,23 @@ class Agent:
                 )
             try:
                 fn(task.get("payload") or {}, self._op_context("follower"))
-            except Exception as exc:  # noqa: BLE001 — never desync the slice
-                self.rate.log("follower", "op raised", type=type(exc).__name__)
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                # Same reasoning as the missing-handler branch: a follower
+                # that raised host-locally (e.g. a drain CSV readable only on
+                # host 0) never reached the SPMD program, and the leader is
+                # already blocked in it spanning our devices. Log-and-continue
+                # would loop us back into the *broadcast* collective — two
+                # processes in different collectives, a silent slice-wide
+                # hang. Crash instead: the coordination service's heartbeat
+                # then tears the slice down visibly and the controller
+                # re-leases the task.
+                log(
+                    "follower op raised — crashing to avoid a slice hang",
+                    op=task.get("op"),
+                    type=type(exc).__name__,
+                    error=str(exc)[:200],
+                )
+                raise
             self.tasks_done += 1
         log("follower drained", tasks_done=self.tasks_done)
 
@@ -368,17 +404,20 @@ class Agent:
             self.run_follower()
             return
         steps = 0
-        try:
-            while self.running:
-                self.step()
-                steps += 1
-                if max_steps is not None and steps >= max_steps:
-                    break
-        finally:
-            if info.process_count > 1:
-                from agent_tpu.runtime.distributed import broadcast_shutdown
+        while self.running:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        # Clean exit only: after an op exception the followers are desynced
+        # or dead, and the shutdown broadcast is itself a collective —
+        # entering it would recreate the silent slice hang the lockstep
+        # crash exists to avoid. On the error path the exception propagates,
+        # the leader dies, and the coordination heartbeat tears down the rest.
+        if info.process_count > 1:
+            from agent_tpu.runtime.distributed import broadcast_shutdown
 
-                broadcast_shutdown()
+            broadcast_shutdown()
 
     def shutdown(self, *_args: Any) -> None:
         """Signal handler: finish the in-flight task, then exit the loop

@@ -123,6 +123,11 @@ class Controller:
                 raise ValueError(
                     f"required_labels[{k!r}] must be True or a scalar, got {v!r}"
                 )
+        if isinstance(after, (set, frozenset)):
+            # collect_partials materializes dependency results in after
+            # order — an unordered collection would make shard order
+            # nondeterministic. Force callers to pass a sequence.
+            raise ValueError("after must be an ordered sequence, not a set")
         after_order = tuple(after or ())
         job = Job(
             job_id=job_id,
@@ -241,13 +246,28 @@ class Controller:
         produces strings (or True), so a JSON-typed requirement like
         ``{"mem_gb": 16}`` must still match an agent advertising ``"16"`` —
         a strict type-sensitive compare would starve the job silently.
+        Numeric requirements compare numerically first, so ``{"mem_gb": 16.0}``
+        also matches ``"16"`` (str-coercing 16.0 to "16.0" would reintroduce
+        exactly the silent starvation the coercion exists to prevent).
         """
         for key, want in job.required_labels.items():
             have = labels.get(key)
             if want is True:
                 if not _truthy(have):  # absent, falsy, or "false"/"0"/...
                     return False
-            elif have is None or str(have) != str(want):
+            elif have is None:
+                return False
+            elif isinstance(want, (int, float)) and not isinstance(want, bool):
+                if isinstance(have, bool):
+                    # A bare flag label (True) carries no value — it must not
+                    # satisfy a numeric requirement via float(True) == 1.0.
+                    return False
+                try:
+                    if float(have) != float(want):
+                        return False
+                except (TypeError, ValueError):
+                    return False
+            elif str(have) != str(want):
                 return False
         return True
 

@@ -2,9 +2,11 @@
 
 The reference executed models through third-party stacks (INT8 TFLite on an Edge
 TPU, reference ``ops/map_classify_tpu.py``; torch BART on host CPU, reference
-``ops/map_summarize.py``). Here every model is a Flax module compiled with
-``jax.jit``/``pjit`` over the mesh, and tokenization is in-repo (no hub
-downloads — the framework must run with zero egress).
+``ops/map_summarize.py``). Here every model is a pure-JAX param-dict function
+(deliberately not Flax: pytrees of arrays shard/checkpoint/transform with zero
+framework indirection) compiled with ``jax.jit``/``pjit`` over the mesh, and
+tokenization is in-repo (no hub downloads — the framework must run with zero
+egress).
 
 Submodules import lazily; importing ``agent_tpu.models`` does not pull in JAX.
 """

@@ -85,7 +85,15 @@ def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List, str, bool]:
         for v in raw:
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 raise ValueError("input values must be numeric")
-            ids.append(int(v) % cfg.vocab_size)
+            iv = int(v)
+            if not 0 <= iv < cfg.vocab_size:
+                # Validate-and-reject like the reference's size/shape checks
+                # (ref ops/map_classify_tpu.py:58-69) — silently wrapping
+                # out-of-range ids would hide caller bugs.
+                raise ValueError(
+                    f"input id {iv} out of range [0, {cfg.vocab_size})"
+                )
+            ids.append(iv)
         return [ids[: cfg.max_len]], "ids", True
     texts = payload.get("texts")
     single = False

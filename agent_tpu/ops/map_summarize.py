@@ -30,6 +30,12 @@ from agent_tpu.utils.errors import bad_input
 DEFAULT_MODEL_ID = "summarize-default"
 DEFAULT_MAX_LENGTH = 130
 
+# One-shot guard for the default-inversion notice in run(): the framework
+# default (device execution) is the INVERSE of the reference's CPU-on default,
+# and that must be visible in operational logs of processes that actually run
+# summarize (only those — hence here, not in config.py).
+_force_cpu_default_logged = False
+
 
 def _resolve_model_id(payload: Dict[str, Any]) -> str:
     from agent_tpu.ops._model_common import resolve_model_id
@@ -189,6 +195,18 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         if ctx is not None and getattr(ctx, "config", None) is not None
         else OpsConfig.from_env()
     )
+    global _force_cpu_default_logged
+    if not ops_cfg.summarize_force_cpu and not _force_cpu_default_logged \
+            and "SUMMARIZE_FORCE_CPU" not in os.environ:
+        # Only on the untouched-default path: an operator who set the var
+        # (either way) made a choice and needs no notice.
+        _force_cpu_default_logged = True
+        from agent_tpu.utils.logging import log as _log
+
+        _log(
+            "summarize runs on the device backend by default "
+            "(the reference defaulted to CPU; SUMMARIZE_FORCE_CPU=1 forces CPU)"
+        )
     if ops_cfg.summarize_force_cpu:
         from agent_tpu.ops.map_classify_tpu import _get_cpu_runtime
 

@@ -216,6 +216,38 @@ class TestLabelScheduling:
         c.submit("echo", {}, required_labels={"mem_gb": 16})
         assert c.lease("a", {"ops": ["echo"]}, labels={"mem_gb": "16"}) is not None
 
+    def test_float_requirement_matches_int_string_label(self):
+        """{"mem_gb": 16.0} must match an agent advertising "16" — numeric
+        requirements compare numerically, not via str() coercion."""
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        c.submit("echo", {}, required_labels={"mem_gb": 16.0})
+        assert c.lease("x", {"ops": ["echo"]}, labels={"mem_gb": "nope"}) is None
+        assert c.lease("a", {"ops": ["echo"]}, labels={"mem_gb": "16"}) is not None
+
+    def test_bare_flag_label_does_not_satisfy_numeric_requirement(self):
+        """A bare token label parses to True; float(True) == 1.0 must not
+        make it satisfy {"slots": 1}."""
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        c.submit("echo", {}, required_labels={"slots": 1})
+        assert c.lease("a", {"ops": ["echo"]}, labels={"slots": True}) is None
+        assert c.lease("b", {"ops": ["echo"]}, labels={"slots": "1"}) is not None
+
+    def test_after_rejects_unordered_set(self):
+        """collect_partials relies on after order — sets are ambiguous."""
+        import pytest as _pytest
+
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        a = c.submit("echo", {})
+        with _pytest.raises(ValueError, match="ordered"):
+            c.submit("echo", {}, after={a})
+        c.submit("echo", {}, after=[a])  # sequences stay fine
+
     def test_csv_job_carries_required_labels(self):
         from agent_tpu.controller.core import Controller
 

@@ -77,6 +77,14 @@ def test_bad_input_soft_errors(classify, ctx):
     assert classify("not a dict", ctx)["ok"] is False
 
 
+def test_out_of_range_ids_rejected(classify, ctx):
+    """Validate-and-reject like the reference's shape checks (ref :58-69) —
+    no silent modulo wrap hiding caller bugs."""
+    out = classify({"input": [0, 99999]}, ctx)
+    assert out["ok"] is False and "out of range" in out["error"]
+    assert classify({"input": [-1]}, ctx)["ok"] is False
+
+
 class _BrokenRuntime:
     def require_runtime(self):
         raise RuntimeError("device wedged")
