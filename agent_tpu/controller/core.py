@@ -20,12 +20,27 @@ Design decisions:
   to two leases), ``stale_epoch`` (bump a job's epoch right after leasing so
   the result arrives stale).
 
-Everything is in-memory and lock-guarded; the HTTP layer in ``server.py`` is a
+State is in-memory and lock-guarded; the HTTP layer in ``server.py`` is a
 thin adapter over this class, so tests can drive it directly in-process.
+Two durability/liveness extras beyond the reference protocol:
+
+- **Background sweeper** (``sweep_interval_sec``): TTL expiry runs on a timer,
+  not only inside ``lease()`` — with no polling agents, expired leases still
+  re-queue and ``/v1/status`` stays truthful.
+- **Append-only journal** (``journal_path``): submissions, accepted results,
+  and expiry requeues are journaled as JSONL; a restarted controller replays
+  the file and resumes a half-drained job — completed shards stay completed,
+  in-flight ones re-queue with a bumped epoch so late results from the
+  previous incarnation are fenced. Result *bodies* are durable only for jobs
+  some other job depends on (reduce partials); journaling every drain shard's
+  output would duplicate the whole dataset, so operators should fetch map
+  results as shards complete (GET ``/v1/jobs/<id>``) or add a reduce stage.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 import uuid
@@ -38,6 +53,10 @@ PENDING = "pending"
 LEASED = "leased"
 SUCCEEDED = "succeeded"
 FAILED = "failed"
+
+# Reference default shard size (ref ops/csv_shard.py:62) — the fallback when
+# no worker profile has suggested anything better.
+DEFAULT_SHARD_ROWS = 100
 
 
 def _truthy(value: Any) -> bool:
@@ -87,6 +106,8 @@ class Controller:
         self,
         lease_ttl_sec: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
+        journal_path: Optional[str] = None,
+        sweep_interval_sec: Optional[float] = None,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
         self._clock = clock
@@ -97,6 +118,122 @@ class Controller:
         self.stale_results = 0
         self.last_metrics: Dict[str, Any] = {}
         self.last_profile: Dict[str, Any] = {}
+        # The most recent profile that actually carried a TPU sizing hint —
+        # kept separately because in a mixed fleet every leasing agent
+        # overwrites last_profile, and a CPU agent's poll must not revert
+        # shard sizing to the fallback.
+        self._last_tpu_profile: Dict[str, Any] = {}
+        # Job ids some other job depends on (reduce stages): their result
+        # bodies must survive a restart, so only these journal results.
+        self._depended_on: Set[str] = set()
+        self._journal_file = None
+        if journal_path:
+            self._replay_journal(journal_path)
+            self._journal_file = open(journal_path, "a", encoding="utf-8")
+        self._sweeper: Optional[threading.Thread] = None
+        self._sweep_stop = threading.Event()
+        if sweep_interval_sec:
+            self.start_sweeper(sweep_interval_sec)
+
+    # ---- durability (journal) ----
+
+    def _journal(self, event: Dict[str, Any]) -> None:
+        # Caller holds the lock; writes are ordered with the state changes
+        # they record. fsync is deliberately skipped: the journal protects
+        # against controller restarts, not kernel crashes, and a 10M-row
+        # drain posts thousands of shard results.
+        if self._journal_file is not None:
+            self._journal_file.write(json.dumps(event) + "\n")
+            self._journal_file.flush()
+
+    def _replay_journal(self, path: str) -> None:
+        """Rebuild job state from a previous incarnation's journal. Runs
+        before the journal opens for append, without the lock (no other
+        thread can hold a reference yet)."""
+        if not os.path.exists(path):
+            return
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # torn final write from a crash — ignore
+                if ev.get("ev") == "submit":
+                    after_order = tuple(ev.get("after") or ())
+                    self._jobs[ev["job_id"]] = Job(
+                        job_id=ev["job_id"],
+                        op=ev["op"],
+                        payload=ev.get("payload") or {},
+                        after=set(after_order),
+                        after_order=after_order,
+                        required_labels=ev.get("required_labels") or {},
+                    )
+                    self._depended_on.update(after_order)
+                elif ev.get("ev") == "result":
+                    job = self._jobs.get(ev.get("job_id"))
+                    if job is None:
+                        continue
+                    job.state = ev.get("state", job.state)
+                    job.epoch = int(ev.get("epoch", job.epoch))
+                    job.attempts = int(ev.get("attempts", job.attempts))
+                    job.result = ev.get("result")
+                    job.error = ev.get("error")
+                elif ev.get("ev") == "requeue":
+                    # Lease-expiry epoch bump: must replay, or a result the
+                    # previous incarnation had fenced off could be accepted
+                    # after restart (its epoch would collide with ours).
+                    job = self._jobs.get(ev.get("job_id"))
+                    if job is not None:
+                        job.epoch = int(ev.get("epoch", job.epoch))
+        # Jobs that were pending or in flight when the previous controller
+        # died re-queue with a bumped epoch: an agent still holding the old
+        # task posts a stale result, which fencing discards.
+        for job in self._jobs.values():
+            if job.state not in (SUCCEEDED, FAILED):
+                job.state = PENDING
+                job.epoch += 1
+                job.lease_id = None
+        self._queue = [
+            j.job_id for j in self._jobs.values() if j.state == PENDING
+        ]
+
+    # ---- liveness (background TTL sweeper) ----
+
+    def sweep(self) -> None:
+        """Re-queue expired leases now (also runs inside every ``lease()``)."""
+        with self._lock:
+            self._expire_leases_locked()
+
+    def start_sweeper(self, interval_sec: float = 5.0) -> None:
+        """TTL enforcement without traffic: a daemon thread sweeping every
+        ``interval_sec`` so dead agents' tasks re-queue even when no other
+        agent is polling."""
+        if self._sweeper is not None:
+            return
+        self._sweep_stop.clear()
+
+        def loop() -> None:
+            while not self._sweep_stop.wait(interval_sec):
+                self.sweep()
+
+        self._sweeper = threading.Thread(
+            target=loop, name="lease-sweeper", daemon=True
+        )
+        self._sweeper.start()
+
+    def close(self) -> None:
+        """Stop the sweeper and close the journal (idempotent)."""
+        self._sweep_stop.set()
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=5)
+            self._sweeper = None
+        with self._lock:
+            if self._journal_file is not None:
+                self._journal_file.close()
+                self._journal_file = None
 
     # ---- job submission ----
 
@@ -128,6 +265,10 @@ class Controller:
             # order — an unordered collection would make shard order
             # nondeterministic. Force callers to pass a sequence.
             raise ValueError("after must be an ordered sequence, not a set")
+        if isinstance(after, str):
+            # tuple("job-1") would split into characters and the dependency
+            # would silently vanish (unknown ids are skipped in dep checks).
+            raise ValueError("after must be a sequence of job ids, not a str")
         after_order = tuple(after or ())
         job = Job(
             job_id=job_id,
@@ -142,13 +283,38 @@ class Controller:
                 raise ValueError(f"duplicate job id {job_id!r}")
             self._jobs[job_id] = job
             self._queue.append(job_id)
+            self._depended_on.update(after_order)
+            self._journal(
+                {
+                    "ev": "submit",
+                    "job_id": job_id,
+                    "op": op,
+                    "payload": job.payload,
+                    "after": list(after_order),
+                    "required_labels": required_labels,
+                }
+            )
         return job_id
+
+    def suggested_shard_size(self) -> Optional[int]:
+        """The ``tpu.suggested_shard_rows`` hint from the most recent lease
+        that carried one (``sizing/profile.py`` derives it from chip count ×
+        HBM), or None when no TPU agent has leased yet. CPU agents polling in
+        a mixed fleet do not revert the hint."""
+        with self._lock:
+            profile = self._last_tpu_profile
+        tpu = (profile or {}).get("tpu") or {}
+        rows = tpu.get("suggested_shard_rows")
+        if isinstance(rows, (int, float)) and not isinstance(rows, bool) \
+                and rows > 0:
+            return int(rows)
+        return None
 
     def submit_csv_job(
         self,
         source_uri: str,
         total_rows: int,
-        shard_size: int,
+        shard_size: Optional[int] = None,
         map_op: str = "read_csv_shard",
         extra_payload: Optional[Dict[str, Any]] = None,
         reduce_op: Optional[str] = None,
@@ -161,12 +327,20 @@ class Controller:
         Shards address rows ``[start_row, start_row + shard_size)`` — idempotent
         re-execution is the resume unit (SURVEY.md §5.4).
 
+        ``shard_size=None`` closes the sizing→controller loop (SURVEY.md §2.5):
+        the split uses the submitting cluster's last-seen worker profile
+        (``tpu.suggested_shard_rows``, derived from topology + HBM), falling
+        back to the reference's 100-row default when no TPU agent has leased
+        yet. Pass an explicit size to override.
+
         With ``collect_partials`` the controller materializes the shard jobs'
         results into the reduce job's ``partials`` payload when it leases —
         the "partials combined controller-side" flow the reference implied
         (SURVEY.md §5.8) made explicit, e.g. ``map_op="risk_accumulate"``
         (per-shard stats) + ``reduce_op="risk_accumulate"`` (merge).
         """
+        if shard_size is None:
+            shard_size = self.suggested_shard_size() or DEFAULT_SHARD_ROWS
         if shard_size <= 0:
             raise ValueError("shard_size must be positive")
         if total_rows <= 0:
@@ -229,6 +403,9 @@ class Controller:
                 job.state = PENDING
                 job.lease_id = None
                 self._queue.append(job.job_id)
+                self._journal(
+                    {"ev": "requeue", "job_id": job.job_id, "epoch": job.epoch}
+                )
 
     def _deps_done_locked(self, job: Job) -> bool:
         return all(
@@ -289,6 +466,9 @@ class Controller:
                 self.last_metrics = metrics
             if worker_profile:
                 self.last_profile = worker_profile
+                tpu = worker_profile.get("tpu") or {}
+                if isinstance(tpu, dict) and tpu.get("suggested_shard_rows"):
+                    self._last_tpu_profile = worker_profile
             self._expire_leases_locked()
             if self._take_fault("drop_lease"):
                 return None
@@ -376,6 +556,26 @@ class Controller:
                     job.state = PENDING
                     job.epoch += 1
                     self._queue.append(job.job_id)
+            # Journal the post-decision state (not the raw report): replay
+            # applies it verbatim, so a failed-then-requeued job replays as
+            # pending at the bumped epoch and a completed shard stays done.
+            # Result bodies are journaled only for depended-on jobs (a reduce
+            # will need them as partials after a restart) — journaling every
+            # drain shard's output would make the journal an unbounded second
+            # copy of the dataset.
+            self._journal(
+                {
+                    "ev": "result",
+                    "job_id": job.job_id,
+                    "state": job.state,
+                    "epoch": job.epoch,
+                    "attempts": job.attempts,
+                    "result": (
+                        job.result if job.job_id in self._depended_on else None
+                    ),
+                    "error": job.error,
+                }
+            )
             return {"accepted": True}
 
     # ---- introspection (for tests, bench, and a future status endpoint) ----

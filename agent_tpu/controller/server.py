@@ -80,7 +80,12 @@ class _Handler(BaseHTTPRequestHandler):
                     shard_ids, reduce_id = self.controller.submit_csv_job(
                         source_uri=str(body["source_uri"]),
                         total_rows=int(body["total_rows"]),
-                        shard_size=int(body.get("shard_size", 100)),
+                        # Absent → None → profile-derived shard sizing.
+                        shard_size=(
+                            int(body["shard_size"])
+                            if body.get("shard_size") is not None
+                            else None
+                        ),
                         map_op=str(body.get("map_op", "read_csv_shard")),
                         extra_payload=body.get("extra_payload"),
                         reduce_op=body.get("reduce_op"),
@@ -180,7 +185,14 @@ def main() -> int:
     host = env_str("CONTROLLER_HOST", "0.0.0.0")
     port = env_int("CONTROLLER_PORT", 8080)
     ttl = env_float("LEASE_TTL_SEC", 30.0)
-    server = ControllerServer(Controller(lease_ttl_sec=ttl), host=host, port=port)
+    journal = env_str("CONTROLLER_JOURNAL", "") or None
+    sweep = env_float("CONTROLLER_SWEEP_SEC", 5.0)
+    controller = Controller(
+        lease_ttl_sec=ttl,
+        journal_path=journal,
+        sweep_interval_sec=sweep if sweep > 0 else None,
+    )
+    server = ControllerServer(controller, host=host, port=port)
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -188,6 +200,7 @@ def main() -> int:
     print(f"[agent-tpu-controller] serving on {server.url}", flush=True)
     stop.wait()
     server.stop()
+    controller.close()
     print("[agent-tpu-controller] stopped", flush=True)
     return 0
 

@@ -189,7 +189,9 @@ def detect_tpu(device_cfg: Optional[DeviceConfig] = None) -> Dict[str, Any]:
 
 def _tpu_batch_hints(tpu: Dict[str, Any]) -> Dict[str, int]:
     """Topology-derived batching hints — the TPU-native replacement for sizing
-    by CPU core count. The controller uses these when splitting jobs.
+    by CPU core count. The controller reads ``suggested_shard_rows`` from the
+    last-seen profile when ``submit_csv_job`` is called without an explicit
+    ``shard_size`` (``controller/core.py::suggested_shard_size``).
 
     suggested_batch: rows per device step — sized so activation memory stays a
     small slice of HBM at our default encoder footprint; multiple of chip count

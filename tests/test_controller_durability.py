@@ -1,0 +1,254 @@
+"""Controller durability + sizing feedback (VERDICT r2 items 6 and 8):
+profile-derived shard splitting, background TTL sweeper, journal resume."""
+
+import time
+
+from agent_tpu.controller.core import DEFAULT_SHARD_ROWS, Controller
+from agent_tpu.sizing.profile import _tpu_batch_hints
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _tpu_profile(hbm_gb: int, chips: int = 4):
+    """A worker profile as sizing/profile.py would build it for this HBM."""
+    tpu = {
+        "tpu_present": True,
+        "n_chips": chips,
+        "hbm_bytes_per_chip": hbm_gb * 2**30,
+    }
+    return {"tier": "tpu-pod", "tpu": dict(tpu, **_tpu_batch_hints(tpu))}
+
+
+class TestSizingFeedback:
+    def test_shard_size_derived_from_leased_profile(self):
+        """The sizing→controller loop: a TPU agent's advertised profile
+        changes how submit_csv_job(shard_size=None) splits the dataset."""
+        c = Controller()
+        c.lease("a", {"ops": ["x"]}, worker_profile=_tpu_profile(hbm_gb=16))
+        big = c.suggested_shard_size()
+        ids_big, _ = c.submit_csv_job("d.csv", total_rows=8 * big)
+        assert len(ids_big) == 8
+
+        c2 = Controller()
+        c2.lease("a", {"ops": ["x"]}, worker_profile=_tpu_profile(hbm_gb=4))
+        small = c2.suggested_shard_size()
+        assert small < big  # less HBM ⇒ smaller shards
+        ids_small, _ = c2.submit_csv_job("d.csv", total_rows=8 * big)
+        assert len(ids_small) == 8 * big // small > 8
+
+    def test_fallback_to_reference_default_without_profile(self):
+        c = Controller()
+        assert c.suggested_shard_size() is None
+        ids, _ = c.submit_csv_job("d.csv", total_rows=250)
+        assert len(ids) == -(-250 // DEFAULT_SHARD_ROWS)
+
+    def test_cpu_profile_yields_no_suggestion(self):
+        c = Controller()
+        c.lease("a", {"ops": ["x"]}, worker_profile={"tier": "cpu", "tpu": {}})
+        assert c.suggested_shard_size() is None
+
+    def test_explicit_shard_size_still_wins(self):
+        c = Controller()
+        c.lease("a", {"ops": ["x"]}, worker_profile=_tpu_profile(hbm_gb=16))
+        ids, _ = c.submit_csv_job("d.csv", total_rows=100, shard_size=50)
+        assert len(ids) == 2
+
+    def test_cpu_agent_poll_does_not_revert_tpu_hint(self):
+        """Mixed fleet: a CPU agent's lease between the TPU agent's lease and
+        the submit must not flip sizing back to the 100-row fallback."""
+        c = Controller()
+        c.lease("tpu-a", {"ops": ["x"]}, worker_profile=_tpu_profile(hbm_gb=16))
+        hint = c.suggested_shard_size()
+        c.lease("cpu-a", {"ops": ["x"]}, worker_profile={"tier": "cpu", "tpu": {}})
+        assert c.suggested_shard_size() == hint
+
+
+class TestSweeper:
+    def test_sweep_requeues_without_lease_traffic(self):
+        clock = FakeClock()
+        c = Controller(lease_ttl_sec=10.0, clock=clock)
+        jid = c.submit("echo", {})
+        c.lease("a", {"ops": ["echo"]})
+        assert c.job(jid).state == "leased"
+        clock.t = 11.0
+        c.sweep()  # no lease() call involved
+        job = c.job(jid)
+        assert job.state == "pending" and job.epoch == 1
+
+    def test_background_sweeper_thread(self):
+        c = Controller(lease_ttl_sec=0.05, sweep_interval_sec=0.02)
+        try:
+            jid = c.submit("echo", {})
+            c.lease("a", {"ops": ["echo"]})
+            deadline = time.time() + 2.0
+            while c.job(jid).state != "pending" and time.time() < deadline:
+                time.sleep(0.02)
+            assert c.job(jid).state == "pending"
+        finally:
+            c.close()
+
+    def test_close_is_idempotent(self):
+        c = Controller(sweep_interval_sec=0.02)
+        c.close()
+        c.close()
+
+
+class TestJournalResume:
+    def _drain_some(self, c, n):
+        done = []
+        for _ in range(n):
+            lease = c.lease("a1", {"ops": ["read_csv_shard"]})
+            task = lease["tasks"][0]
+            c.report(
+                lease["lease_id"], task["id"], task["job_epoch"],
+                "succeeded", {"ok": True, "rows": [task["payload"]["start_row"]]},
+            )
+            done.append(task["id"])
+        return done
+
+    def test_restart_resumes_half_drained_job(self, tmp_path):
+        journal = str(tmp_path / "controller.jsonl")
+        c1 = Controller(journal_path=journal)
+        shard_ids, reduce_id = c1.submit_csv_job(
+            "d.csv", total_rows=400, shard_size=100,
+            reduce_op="risk_accumulate", collect_partials=True,
+        )
+        done = self._drain_some(c1, 2)
+        # A third shard is in flight (leased, unreported) at crash time.
+        inflight = c1.lease("a1", {"ops": ["read_csv_shard"]})
+        inflight_task = inflight["tasks"][0]
+        c1.close()  # "kill" — no further writes
+
+        c2 = Controller(journal_path=journal)
+        counts = c2.counts()
+        assert counts == {"succeeded": 2, "pending": 3}
+        for jid in done:
+            snap = c2.job_snapshot(jid)
+            assert snap["state"] == "succeeded"
+            assert snap["result"]["ok"] is True
+
+        # The previous incarnation's in-flight agent posts late: fenced.
+        out = c2.report(
+            inflight["lease_id"], inflight_task["id"],
+            inflight_task["job_epoch"], "succeeded", {"ok": True},
+        )
+        assert out["accepted"] is False and out["reason"] == "stale epoch"
+
+        # Finish the remaining shards; reduce leases with ordered partials.
+        self._drain_some(c2, 2)
+        lease = c2.lease("a1", {"ops": ["risk_accumulate"]})
+        assert lease is not None
+        partials = lease["tasks"][0]["payload"]["partials"]
+        assert [p["rows"][0] for p in partials] == [0, 100, 200, 300]
+        c2.close()
+
+    def test_failed_requeue_survives_restart(self, tmp_path):
+        journal = str(tmp_path / "c.jsonl")
+        c1 = Controller(journal_path=journal)
+        jid = c1.submit("echo", {})
+        lease = c1.lease("a", {"ops": ["echo"]})
+        c1.report(lease["lease_id"], jid, 0, "failed", error={"type": "X"})
+        assert c1.job(jid).state == "pending"  # one retry granted
+        c1.close()
+
+        c2 = Controller(journal_path=journal)
+        job = c2.job(jid)
+        assert job.state == "pending" and job.attempts == 1
+        # Fails again after restart → sticks failed (retry budget remembered).
+        lease = c2.lease("a", {"ops": ["echo"]})
+        c2.report(
+            lease["lease_id"], jid, lease["tasks"][0]["job_epoch"],
+            "failed", error={"type": "X"},
+        )
+        assert c2.job(jid).state == "failed"
+        c2.close()
+
+    def test_expiry_epoch_bumps_survive_restart(self, tmp_path):
+        """Expiry requeues are journaled: an agent the previous incarnation
+        fenced off must stay fenced after a restart."""
+        clock = FakeClock()
+        journal = str(tmp_path / "c.jsonl")
+        c1 = Controller(lease_ttl_sec=10.0, clock=clock, journal_path=journal)
+        jid = c1.submit("echo", {})
+        lease_a = c1.lease("a", {"ops": ["echo"]})     # epoch 0
+        clock.t = 11.0
+        c1.sweep()                                     # epoch → 1, A fenced
+        lease_b = c1.lease("b", {"ops": ["echo"]})     # epoch 1
+        clock.t = 22.0
+        c1.sweep()                                     # epoch → 2, B fenced
+        c1.lease("c", {"ops": ["echo"]})               # epoch 2, in flight
+        c1.close()                                     # crash
+
+        c2 = Controller(journal_path=journal)
+        # B (fenced at epoch 1 by the old incarnation) posts late: rejected.
+        out = c2.report(lease_b["lease_id"], jid, 1, "succeeded", {"ok": True})
+        assert out["accepted"] is False and out["reason"] == "stale epoch"
+        out = c2.report(lease_a["lease_id"], jid, 0, "succeeded", {"ok": True})
+        assert out["accepted"] is False
+        # The job is re-leasable at an epoch past every fenced one.
+        lease = c2.lease("d", {"ops": ["echo"]})
+        assert lease["tasks"][0]["job_epoch"] >= 3
+        c2.close()
+
+    def test_undepended_result_bodies_not_journaled(self, tmp_path):
+        """Drain shards nobody depends on journal state only — the journal
+        must not become a second copy of the drain output."""
+        import json as _json
+
+        journal = str(tmp_path / "c.jsonl")
+        c1 = Controller(journal_path=journal)
+        shard_ids, reduce_id = c1.submit_csv_job(
+            "d.csv", total_rows=100, shard_size=50,
+            reduce_op="risk_accumulate", collect_partials=True,
+        )
+        solo = c1.submit("echo", {})
+        for _ in range(3):  # two shards + the solo echo
+            lease = c1.lease("a", {"ops": ["read_csv_shard", "echo"]})
+            t = lease["tasks"][0]
+            c1.report(lease["lease_id"], t["id"], t["job_epoch"],
+                      "succeeded", {"ok": True, "big": "x" * 100})
+        c1.close()
+
+        events = [
+            _json.loads(line) for line in open(journal, encoding="utf-8")
+        ]
+        by_id = {e["job_id"]: e for e in events if e["ev"] == "result"}
+        for sid in shard_ids:  # depended on by the reduce → kept
+            assert by_id[sid]["result"]["ok"] is True
+        assert by_id[solo]["result"] is None  # state survives, body dropped
+        # And the replayed controller still reports the solo job done.
+        c2 = Controller(journal_path=journal)
+        assert c2.job_snapshot(solo)["state"] == "succeeded"
+        c2.close()
+
+    def test_after_rejects_bare_string(self):
+        import pytest as _pytest
+
+        c = Controller()
+        jid = c.submit("echo", {})
+        with _pytest.raises(ValueError, match="job ids"):
+            c.submit("echo", {}, after=jid)
+
+    def test_torn_final_line_ignored(self, tmp_path):
+        journal = tmp_path / "c.jsonl"
+        c1 = Controller(journal_path=str(journal))
+        c1.submit("echo", {"x": 1}, job_id="keep")
+        c1.close()
+        with open(journal, "a") as f:
+            f.write('{"ev": "submit", "job_id": "torn", "op"')  # crash mid-write
+
+        c2 = Controller(journal_path=str(journal))
+        assert "keep" in [t["id"] for t in c2.lease("a", {"ops": ["echo"]})["tasks"]]
+        c2.close()
+
+    def test_no_journal_no_files(self, tmp_path):
+        c = Controller()
+        c.submit("echo", {})
+        c.close()
+        assert list(tmp_path.iterdir()) == []
