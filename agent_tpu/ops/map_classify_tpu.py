@@ -151,9 +151,15 @@ def _run_on_runtime(
     buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_len] or [cfg.max_len]
     bbuckets = batch_buckets(dp, MAX_BATCH)
 
+    from agent_tpu.parallel.shardings import encoder_param_specs
+
+    # On a tp>1 mesh the weights land sharded (Megatron-style specs) and XLA
+    # inserts the tp collectives in the forward — the serving path for models
+    # that exceed one chip's HBM, not just the train path.
     params = runtime.get_params(
         f"{model_id}#encoder#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}",
         lambda: _build_params(model_id, cfg),
+        specs=encoder_param_specs(cfg),
     )
     attn_fn = runtime.attention_fn()  # ring over sp when the mesh has one
     pending: List[Tuple[Any, Any, int]] = []

@@ -79,9 +79,13 @@ def _generate(runtime, texts: List[str], model_id: str, cfg,
     buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_src_len] or [cfg.max_src_len]
     bbuckets = batch_buckets(dp, MAX_BATCH)
 
+    from agent_tpu.parallel.shardings import seq2seq_param_specs
+
+    # tp>1 mesh → weights land sharded, same serving-path TP as classify.
     params = runtime.get_params(
         f"{model_id}#seq2seq#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}",
         lambda: _build_params(model_id, cfg),
+        specs=seq2seq_param_specs(cfg),
     )
     summaries: List[str] = []
     attn_fn = runtime.attention_fn()  # ring over sp for the encoder pass

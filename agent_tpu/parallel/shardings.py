@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
+import jax
 from jax.sharding import PartitionSpec as P
 
 Params = Dict[str, Any]
@@ -84,6 +85,40 @@ def seq2seq_param_specs(cfg) -> Params:
         "ln_enc": _ln_specs(),
         "ln_dec": _ln_specs(),
     }
+
+
+def _axes_size(mesh, entry) -> int:
+    """Mesh extent of one PartitionSpec entry (name or tuple of names)."""
+    if entry is None:
+        return 1
+    names = entry if isinstance(entry, tuple) else (entry,)
+    size = 1
+    for n in names:
+        size *= mesh.shape.get(n, 1)
+    return size
+
+
+def sanitize_specs(mesh, params: Any, specs: Any) -> Any:
+    """Per-leaf divisibility guard: any leaf whose sharded dims don't divide
+    the mesh axes gets a replicated ``P()`` instead.
+
+    Lets one spec pytree serve every model config — e.g. a payload
+    ``model_config`` with 6 heads on a tp=4 mesh serves with that projection
+    replicated rather than failing the op.
+    """
+
+    def fix(leaf, spec):
+        shape = getattr(leaf, "shape", ())
+        if len(spec) > len(shape):
+            return P()
+        for dim, entry in zip(shape, spec):
+            if dim % _axes_size(mesh, entry) != 0:
+                return P()
+        return spec
+
+    return jax.tree_util.tree_map(
+        fix, params, specs, is_leaf=lambda x: isinstance(x, P)
+    )
 
 
 def batch_spec() -> P:

@@ -138,34 +138,64 @@ class TpuRuntime:
 
     # ---- params store (TPUHandle cache generalized) ----
 
-    def get_params(self, model_id: str, build: Callable[[], Any]) -> Any:
+    def get_params(
+        self,
+        model_id: str,
+        build: Callable[[], Any],
+        specs: Any = None,
+    ) -> Any:
         """Weights resident on device, built once per process per model id.
 
         ``build()`` returns a pytree. Leaves that are already device-committed
         ``jax.Array``\\ s (a model that sharded its own params over tp) are left
-        exactly as built; only host leaves (numpy) are placed, replicated, on
-        the mesh. Build-once dedup rides the same per-key-event cache as
-        executables, so concurrent first callers trigger exactly one build /
-        one HBM transfer.
+        exactly as built; host leaves (numpy) are placed on the mesh —
+        **sharded** per ``specs`` (a PartitionSpec pytree, e.g.
+        ``parallel.shardings.encoder_param_specs``) when the mesh has a
+        model-parallel axis > 1, replicated otherwise. This is how the serving
+        path runs models that exceed one chip's HBM (SURVEY.md §2.8 TP row):
+        the op passes its spec tree and XLA inserts the tp collectives in the
+        forward. Leaves whose dims don't divide the mesh replicate (see
+        ``shardings.sanitize_specs``). Build-once dedup rides the same
+        per-key-event cache as executables, so concurrent first callers
+        trigger exactly one build / one HBM transfer.
         """
+        use_specs = specs is not None and self.axis_size("tp") > 1
 
         def place() -> Any:
             host = build()
+            if not use_specs:
+                return jax.tree_util.tree_map(
+                    lambda leaf: leaf
+                    if isinstance(leaf, jax.Array) and leaf.committed
+                    else jax.device_put(leaf, self.replicated()),
+                    host,
+                )
+            from agent_tpu.parallel.shardings import sanitize_specs
+
+            safe = sanitize_specs(self.mesh, host, specs)
+
+            def put(leaf, spec):
+                if isinstance(leaf, jax.Array) and leaf.committed:
+                    return leaf
+                return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+
             return jax.tree_util.tree_map(
-                lambda leaf: leaf
-                if isinstance(leaf, jax.Array) and leaf.committed
-                else jax.device_put(leaf, self.replicated()),
-                host,
+                put, host, safe, is_leaf=lambda x: isinstance(x, P)
             )
 
         with self._params_lock:
             self._model_ids.add(model_id)
-        return self._params.get_or_build(("params", model_id), place)
+        # Placement mode is part of the identity: the same model id requested
+        # replicated and tp-sharded must not alias one cache entry.
+        key = ("params", model_id, "tp" if use_specs else "rep")
+        return self._params.get_or_build(key, place)
 
     def evict_params(self, model_id: str) -> None:
         with self._params_lock:
             self._model_ids.discard(model_id)
-        self._params.evict(("params", model_id))
+        # Both placement modes: the id may be resident sharded or replicated.
+        self._params.evict(("params", model_id, "tp"))
+        self._params.evict(("params", model_id, "rep"))
 
     # ---- compiled execution ----
 
