@@ -56,6 +56,7 @@ OP_TO_MODULE: Dict[str, str] = {
     "risk_accumulate": "risk_accumulate",
     "trigger_sap": "trigger_sap",        # now a real registered op (gap 4 fixed)
     "trigger_oracle": "trigger_oracle",
+    "train_classifier": "train_classifier",  # train → .npz artifact → serve
 }
 
 _imported: Dict[str, bool] = {}

@@ -1,0 +1,300 @@
+"""Train a classifier inside the swarm and emit a servable artifact.
+
+The reference never trains — it *serves* pretrained immutable artifacts (a
+compiled ``.tflite`` at a well-known path, reference ``_tpu_runtime.py:23-31``;
+HF hub weights, reference ``ops/map_summarize.py:29-32``). This op closes the
+framework's model lifecycle: a shard-addressed labeled CSV (or inline rows)
+goes in, a ``.npz`` checkpoint comes out at ``output_path``, and
+``map_classify_tpu`` serves it via ``model_path`` with the ``model_config``
+echoed in this op's result — train → checkpoint → serve without leaving the
+lease protocol.
+
+Training is the sharded step from ``models/train.py``: one jitted
+forward+backward+adamw update over the runtime mesh, batch over ``dp``,
+params Megatron-sharded over ``tp`` when the mesh has one (same specs the
+serving path uses, so anything trainable here is servable there).
+
+Payload:
+
+- rows: ``texts`` + ``labels`` lists, or ``source_uri`` (+ optional
+  ``start_row``/``shard_size``, default = the whole file) with ``text_field``
+  (default ``"text"``) / ``label_field`` (default ``"label"``).
+- ``output_path`` (required): where the ``.npz`` artifact lands.
+- ``model_config``: EncoderConfig overrides; ``n_classes`` defaults to the
+  number of distinct labels.
+- knobs: ``epochs`` (3), ``batch_size`` (64, rounded up to a dp multiple),
+  ``learning_rate`` (1e-3), ``eval_fraction`` (0.2), ``seed`` (0),
+  ``init_from`` (model id or ``.npz`` to warm-start).
+
+Result: ``{ok, op, output_path, n_train, n_eval, n_steps, first_epoch_loss,
+last_epoch_loss, eval_accuracy, label_names?, model_config, device}``.
+String labels map to ids by sorted order; the mapping ships in the result and
+in a ``<output_path>.labels.json`` sidecar.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from agent_tpu.ops import register_op
+from agent_tpu.utils.errors import bad_input
+
+DEFAULT_EPOCHS = 3
+DEFAULT_BATCH = 64
+DEFAULT_LR = 1e-3
+DEFAULT_EVAL_FRACTION = 0.2
+
+
+def _collect_rows(payload: Dict[str, Any]) -> Tuple[List[str], List[Any]]:
+    """Payload → (texts, raw_labels); ValueError on malformed payloads
+    (→ soft bad_input), Runtime/OSError on shard integrity (→ failed task)."""
+    texts = payload.get("texts")
+    labels = payload.get("labels")
+    if texts is not None or labels is not None:
+        if (
+            not isinstance(texts, list)
+            or not isinstance(labels, list)
+            or not texts
+            or len(texts) != len(labels)
+            or not all(isinstance(t, str) and t for t in texts)
+        ):
+            raise ValueError(
+                "texts and labels must be equal-length non-empty lists"
+            )
+        return texts, labels
+    if "source_uri" not in payload:
+        raise ValueError(
+            "payload requires 'texts'+'labels' or 'source_uri' CSV addressing"
+        )
+    from agent_tpu.data.csv_index import (
+        count_rows,
+        read_shard,
+        resolve_shard_payload,
+    )
+
+    text_field = payload.get("text_field", "text")
+    label_field = payload.get("label_field", "label")
+    for key, val in (("text_field", text_field), ("label_field", label_field)):
+        if not isinstance(val, str) or not val:
+            raise ValueError(f"{key} must be a non-empty string")
+    p = dict(payload)
+    if "shard_size" not in p:
+        # Training defaults to the whole file, not the 100-row shard default.
+        path, start, _ = resolve_shard_payload({**p, "shard_size": 1})
+        p["shard_size"] = max(1, count_rows(path) - start)
+    path, start, size = resolve_shard_payload(p)
+    # One parse serves both columns (read_shard_column would re-read the
+    # whole shard per field — twice the IO on a whole-file train set). Error
+    # contract matches it: integrity problems raise RuntimeError → the task
+    # FAILS and retries, never a soft result that silently trains on nothing.
+    rows = read_shard(path, start, size)
+    if not rows:
+        raise RuntimeError(f"shard [{start}, {start + size}) of {path!r} is empty")
+    for field in (text_field, label_field):
+        missing = sum(1 for r in rows if field not in r)
+        if missing:
+            raise RuntimeError(
+                f"column {field!r} missing from {missing} rows of {path!r}"
+            )
+    return [r[text_field] for r in rows], [r[label_field] for r in rows]
+
+
+def _map_labels(raw: List[Any]) -> Tuple[np.ndarray, Optional[List[str]]]:
+    """Labels → int ids. All-int labels pass through; strings map by sorted
+    order (returned as label_names, index = class id)."""
+    try:
+        ids = [int(v) for v in raw]
+        if ids and min(ids) >= 0 and all(
+            str(v).strip().lstrip("+").isdigit() for v in raw
+        ):
+            return np.asarray(ids, dtype=np.int32), None
+    except (TypeError, ValueError):
+        pass
+    names = sorted({str(v) for v in raw})
+    index = {n: i for i, n in enumerate(names)}
+    return np.asarray([index[str(v)] for v in raw], dtype=np.int32), names
+
+
+@register_op("train_classifier")
+def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    t0 = time.perf_counter()
+    if not isinstance(payload, dict):
+        return bad_input("payload must be a dict")
+    output_path = payload.get("output_path")
+    if not isinstance(output_path, str) or not output_path.endswith(".npz"):
+        return bad_input("output_path is required and must end in .npz")
+
+    epochs = payload.get("epochs", DEFAULT_EPOCHS)
+    batch_size = payload.get("batch_size", DEFAULT_BATCH)
+    lr = payload.get("learning_rate", DEFAULT_LR)
+    eval_fraction = payload.get("eval_fraction", DEFAULT_EVAL_FRACTION)
+    seed = payload.get("seed", 0)
+    for name, v, lo in (("epochs", epochs, 1), ("batch_size", batch_size, 1)):
+        if isinstance(v, bool) or not isinstance(v, int) or v < lo:
+            return bad_input(f"{name} must be an int >= {lo}")
+    if not isinstance(lr, (int, float)) or isinstance(lr, bool) or lr <= 0:
+        return bad_input("learning_rate must be a positive number")
+    if not isinstance(eval_fraction, (int, float)) or isinstance(eval_fraction, bool) \
+            or not 0 <= eval_fraction < 1:
+        return bad_input("eval_fraction must be in [0, 1)")
+
+    init_from = payload.get("init_from")
+    if init_from is not None and (
+        not isinstance(init_from, str) or not init_from
+    ):
+        return bad_input("init_from must be a non-empty string")
+    if isinstance(init_from, str) and init_from.endswith(".npz"):
+        import os
+
+        if not os.path.exists(init_from):
+            # Silently training from scratch on a typo'd warm-start path
+            # would ship a model that never saw the intended weights.
+            return bad_input(f"init_from checkpoint not found: {init_from!r}")
+
+    try:
+        texts, raw_labels = _collect_rows(payload)
+    except ValueError as exc:
+        return bad_input(str(exc))
+    labels, label_names = _map_labels(raw_labels)
+    n_labels = int(labels.max()) + 1 if labels.size else 2
+
+    from agent_tpu.models.encoder import EncoderConfig
+    from agent_tpu.ops._model_common import config_from_payload
+
+    cfg = config_from_payload(payload, EncoderConfig)
+    overrides = payload.get("model_config") or {}
+    if "n_classes" not in overrides:
+        cfg = cfg.scaled(n_classes=max(2, n_labels))
+    if labels.size and int(labels.max()) >= cfg.n_classes:
+        return bad_input(
+            f"label id {int(labels.max())} >= n_classes {cfg.n_classes}"
+        )
+
+    if ctx is not None and getattr(ctx, "require_runtime", None):
+        runtime = ctx.require_runtime()
+    else:
+        from agent_tpu.runtime.runtime import get_runtime
+
+        runtime = get_runtime()
+
+    import jax
+    import optax
+
+    from agent_tpu.models import encoder, train
+    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS, byte_encode_pad
+    from agent_tpu.parallel import shardings
+
+    # One static shape for the whole run: the smallest bucket covering the
+    # longest row (capped by the model), every batch padded to it.
+    buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_len] or [cfg.max_len]
+    ids_all, len_all = byte_encode_pad(texts, buckets=buckets, max_len_cap=cfg.max_len)
+    L = ids_all.shape[1]
+    mask_all = (np.arange(L)[None, :] < len_all[:, None]).astype(np.int32)
+
+    # Deterministic holdout: every round(1/f)-th row evaluates, the rest train.
+    n = len(texts)
+    idx = np.arange(n)
+    if eval_fraction > 0 and n >= 5:
+        stride = max(2, int(round(1.0 / eval_fraction)))
+        eval_idx = idx[::stride]
+        train_idx = np.setdiff1d(idx, eval_idx)
+    else:
+        eval_idx = np.empty(0, dtype=np.int64)
+        train_idx = idx
+    if train_idx.size == 0:
+        return bad_input("no training rows after eval split")
+
+    dp = runtime.axis_size("dp")
+    B = -(-batch_size // dp) * dp  # round up to a dp multiple
+    rng = np.random.default_rng(seed)
+
+    # Mutable training weights bypass the (immutable) params store: placed
+    # directly with the same sanitized specs the serving path uses, so a
+    # tp-sharded mesh trains sharded. Size-1 axes make the specs replicated.
+    host_params = _init_params(payload, cfg)
+    specs = shardings.sanitize_specs(
+        runtime.mesh, host_params, shardings.encoder_param_specs(cfg)
+    )
+    params = train.place_sharded(runtime, host_params, specs)
+    init_state, step = train.make_train_step(cfg, optax.adamw(float(lr)))
+    opt_state = init_state(params)
+
+    first_epoch_loss = last_epoch_loss = None
+    n_steps = 0
+    for epoch in range(epochs):
+        order = rng.permutation(train_idx)
+        # Tile the tail so every step sees a full [B, L] batch (static shape);
+        # np.resize cycles the array, so n_train < B still fills a batch.
+        order = np.resize(order, -(-order.size // B) * B)
+        losses = []
+        for s in range(0, order.size, B):
+            take = order[s : s + B]
+            params, opt_state, loss = step(
+                params,
+                opt_state,
+                runtime.put_batch(ids_all[take]),
+                runtime.put_batch(mask_all[take]),
+                runtime.put_batch(labels[take]),
+            )
+            losses.append(loss)
+            n_steps += 1
+        epoch_loss = float(np.mean([float(x) for x in losses]))
+        if first_epoch_loss is None:
+            first_epoch_loss = epoch_loss
+        last_epoch_loss = epoch_loss
+
+    # Holdout accuracy through the same forward the serving path compiles.
+    eval_accuracy = None
+    if eval_idx.size:
+        take = np.resize(eval_idx, -(-eval_idx.size // dp) * dp)
+        logits = jax.jit(
+            lambda p, i, m: encoder.forward(p, i, m, cfg)
+        )(params, runtime.put_batch(ids_all[take]), runtime.put_batch(mask_all[take]))
+        pred = np.asarray(jax.numpy.argmax(logits, axis=-1))[: eval_idx.size]
+        eval_accuracy = float(np.mean(pred == labels[eval_idx]))
+
+    from agent_tpu.models import checkpoint
+
+    checkpoint.save_npz(params, output_path)
+    if label_names is not None:
+        with open(output_path + ".labels.json", "w", encoding="utf-8") as f:
+            json.dump(label_names, f)
+
+    from agent_tpu.ops._model_common import cfg_key
+
+    out: Dict[str, Any] = {
+        "ok": True,
+        "op": "train_classifier",
+        "output_path": output_path,
+        "n_train": int(train_idx.size),
+        "n_eval": int(eval_idx.size),
+        "n_steps": n_steps,
+        "first_epoch_loss": first_epoch_loss,
+        "last_epoch_loss": last_epoch_loss,
+        "eval_accuracy": eval_accuracy,
+        # Serve with: {"model_path": output_path, "model_config": this}.
+        "model_config": dict(cfg_key(cfg)),
+        "device": runtime.platform,
+        "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
+    }
+    if label_names is not None:
+        out["label_names"] = label_names
+    return out
+
+
+def _init_params(payload: Dict[str, Any], cfg):
+    """Fresh or warm-started initial weights (``init_from`` path existence is
+    validated up front in ``run`` — a missing warm-start must error, not
+    silently train from scratch)."""
+    from agent_tpu.models import encoder
+
+    init_from = payload.get("init_from")
+    if isinstance(init_from, str) and init_from:
+        if init_from.endswith(".npz"):
+            return encoder.load_npz(init_from, cfg)
+        return encoder.init_params(cfg, model_id=init_from)
+    return encoder.init_params(cfg, model_id=f"train-seed:{payload.get('seed', 0)}")

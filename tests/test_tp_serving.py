@@ -89,11 +89,9 @@ def test_summarize_tp_matches_replicated(rt_rep, rt_tp):
 
     summarize = get_op("map_summarize")
     cfg = {
-        "d_model": 32, "n_heads": 4, "n_layers": 0, "n_enc_layers": 1,
-        "n_dec_layers": 1, "d_ff": 64, "max_src_len": 64, "max_tgt_len": 16,
-        "dtype": "float32",
+        "d_model": 32, "n_heads": 4, "n_enc_layers": 1, "n_dec_layers": 1,
+        "d_ff": 64, "max_src_len": 64, "max_tgt_len": 16, "dtype": "float32",
     }
-    cfg = {k: v for k, v in cfg.items() if k != "n_layers"}
     payload = {
         "texts": ["a long document about tensor parallel serving " * 3] * 4,
         "max_length": 8,
