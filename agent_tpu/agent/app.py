@@ -94,12 +94,16 @@ class Agent:
 
     # ---- controller I/O ----
 
-    def _post_json(self, path: str, body: Dict[str, Any]) -> Tuple[int, Any]:
+    def _post_json(
+        self, path: str, body: Dict[str, Any], session: Any = None
+    ) -> Tuple[int, Any]:
         """POST JSON → (status, parsed body). Status 0 = transport error; JSON
-        parse falls back to raw text (reference ``app.py:143-158``)."""
+        parse falls back to raw text (reference ``app.py:143-158``).
+        ``session`` overrides the agent's session — the pipelined poster
+        thread brings its own (requests.Session is not thread-safe)."""
         url = f"{self.config.agent.controller_url}{path}"
         try:
-            resp = self.session.post(
+            resp = (session or self.session).post(
                 url, json=body, timeout=self.config.agent.http_timeout_sec
             )
         except Exception as exc:  # noqa: BLE001 — any transport failure
@@ -167,6 +171,7 @@ class Agent:
         status: str,
         result: Any = None,
         error: Any = None,
+        session: Any = None,
     ) -> bool:
         http_status, body = self._post_json(
             "/v1/results",
@@ -178,6 +183,7 @@ class Agent:
                 "result": result,
                 "error": error,
             },
+            session=session,
         )
         if http_status not in (200, 204):
             self.rate.log(
@@ -215,20 +221,52 @@ class Agent:
             runtime=self.runtime, config=self.config, tags={"job_id": job_id}
         )
 
-    def _maybe_profiled(self, op: str, fn: OpFn, payload: Dict[str, Any],
-                        ctx: Any) -> Any:
-        """Execute the op, capturing an XProf trace for the first
+    def profiled_call(self, op: str, thunk: Any) -> Any:
+        """Run ``thunk`` capturing an XProf trace for the first
         ``profile_tasks`` tasks when PROFILE_DIR is set (SURVEY.md §5.1 —
         result-embedded wall-clock timings flow regardless; traces are the
-        deep-dive channel)."""
+        deep-dive channel). Shared by the serial loop and the pipelined
+        device loop so profiling covers phased ops too."""
         dev = self.config.device
         if dev.profile_dir and self.tasks_done < dev.profile_tasks:
             import jax
 
             with jax.profiler.trace(dev.profile_dir):
                 with jax.profiler.TraceAnnotation(f"op:{op}"):
-                    return fn(payload, ctx)
-        return fn(payload, ctx)
+                    return thunk()
+        return thunk()
+
+    def _maybe_profiled(self, op: str, fn: OpFn, payload: Dict[str, Any],
+                        ctx: Any) -> Any:
+        return self.profiled_call(op, lambda: fn(payload, ctx))
+
+    def resolve_task(
+        self, task: Any
+    ) -> Tuple[Optional[str], str, Dict[str, Any], Any, Optional[OpFn],
+               Optional[Dict[str, Any]]]:
+        """Task dict → ``(job_id, op, payload, epoch, handler, error)``.
+
+        The single definition of malformed-task salvage and the UnknownOp
+        error shape, shared by the serial loop and the pipeline so the two
+        paths can never drift in what they report. ``handler`` is None iff
+        ``error`` is set; a malformed task with no salvageable id returns
+        ``job_id=None`` (nothing to report against — drop it).
+        """
+        try:
+            job_id, op, payload, epoch = self.extract_task(task)
+        except ValueError as exc:
+            self.rate.log("task:bad", "malformed task", error=str(exc))
+            jid = task.get("id") if isinstance(task, dict) else None
+            jid = jid if isinstance(jid, str) and jid else None
+            return jid, "?", {}, None, None, structured_error(exc)
+        fn = self.handlers.get(op)
+        if fn is None:
+            return job_id, op, payload, epoch, None, {
+                "type": "UnknownOp",
+                "message": f"op {op!r} not in capabilities {sorted(self.handlers)}",
+                "trace": "",
+            }
+        return job_id, op, payload, epoch, fn, None
 
     def run_task(self, lease_id: str, task: Any) -> None:
         """Execute one leased task inline and report its result.
@@ -241,31 +279,12 @@ class Agent:
         program would wedge the slice silently.
         """
         t0 = time.perf_counter()
-        try:
-            job_id, op, payload, epoch = self.extract_task(task)
-        except ValueError as exc:
-            self.rate.log("task:bad", "malformed task", error=str(exc))
-            # Without a job_id there is nothing to report against; drop it.
-            job_id = task.get("id") if isinstance(task, dict) else None
-            if isinstance(job_id, str) and job_id:
+        job_id, op, payload, epoch, fn, resolve_error = self.resolve_task(task)
+        if resolve_error is not None:
+            if job_id is not None:
                 self.post_result(
-                    lease_id, job_id, None, "failed", error=structured_error(exc)
+                    lease_id, job_id, epoch, "failed", error=resolve_error
                 )
-            return
-
-        fn = self.handlers.get(op)
-        if fn is None:
-            self.post_result(
-                lease_id,
-                job_id,
-                epoch,
-                "failed",
-                error={
-                    "type": "UnknownOp",
-                    "message": f"op {op!r} not in capabilities {sorted(self.handlers)}",
-                    "trace": "",
-                },
-            )
             return
 
         ctx = self._op_context(job_id)
@@ -402,6 +421,19 @@ class Agent:
         info = self.dist
         if info.process_count > 1 and not info.is_leader:
             self.run_follower()
+            return
+        if (
+            max_steps is None
+            and info.process_count == 1
+            and self.config.agent.pipeline_depth > 0
+        ):
+            # Host-side double buffering: stage/post on worker threads,
+            # device dispatch stays here on the owning thread. Multi-host
+            # keeps the serial lockstep loop (broadcast must serialize);
+            # max_steps callers (tests) drive the deterministic serial loop.
+            from agent_tpu.agent.pipeline import PipelineRunner
+
+            PipelineRunner(self, depth=self.config.agent.pipeline_depth).run()
             return
         steps = 0
         while self.running:

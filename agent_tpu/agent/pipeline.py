@@ -1,0 +1,254 @@
+"""Pipelined drain: host-side double buffering around the device loop.
+
+The serial agent loop pays, per task: lease RTT → CSV read + tokenize/pad →
+device compute → serialize + result RTT, all on one thread — so the device
+idles while the host stages and posts (the round-2 gap: drain < pure-op
+throughput). This runner overlaps them (BASELINE.json north star: "streams
+shards straight into HBM with host-side double buffering"):
+
+- **stager thread**: leases tasks and runs each op's ``stage`` phase (payload
+  validation, shard read, fused tokenize+pad → numpy) feeding a bounded
+  queue of depth ``pipeline_depth``; the bound is the backpressure that keeps
+  staging ~one shard ahead of the device instead of reading the whole
+  dataset into RAM.
+- **device (calling) thread**: pops staged work and runs the op's ``execute``
+  phase — every device touch stays on this one thread, preserving the
+  single-owner invariant the reference called the "TPU RULE" (reference
+  ``app.py:286``; SURVEY.md §5.2). No forks, no process pools.
+- **poster thread**: runs ``finalize`` (numpy → JSON shapes) and posts the
+  result over its own HTTP session.
+
+Ops advertise phases as attributes on their registered handler
+(``fn.stage/.execute/.finalize``, see ``ops/map_classify_tpu.py``); ops
+without them run monolithically on the device thread, so the pipeline is
+safe for every op.
+
+Wire-protocol semantics are unchanged: same lease/result bodies, same
+structured errors, same epoch fencing. Results may post out of task order —
+the protocol never required ordering (results are keyed by job_id).
+Multi-host slices don't use this runner: leader/follower lockstep broadcast
+serializes by design (``agent/app.py``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from agent_tpu.utils.errors import structured_error
+from agent_tpu.utils.logging import log
+
+
+@dataclass
+class _Item:
+    """One leased task moving through the pipeline."""
+
+    lease_id: str
+    job_id: str
+    epoch: Any
+    op: str
+    payload: Dict[str, Any]
+    ctx: Any
+    t_start: float
+    fn: Any = None
+    staged: Any = None            # op state between stage and execute
+    executed: Any = None          # op state between execute and finalize
+    result: Any = None            # terminal result (skips later phases)
+    status: str = "succeeded"
+    error: Any = None
+    monolithic: bool = False      # op has no phase hooks
+
+
+_STOP = object()
+
+
+class PipelineRunner:
+    """Owns the stager/poster threads around the caller's device loop.
+
+    ``runner.run()`` blocks on the device loop until ``agent.running`` flips
+    false (signal handler or test), then drains both queues so no leased task
+    is dropped on shutdown — same graceful-drain contract as the serial loop.
+    """
+
+    def __init__(self, agent, depth: int = 2) -> None:
+        self.agent = agent
+        self.depth = max(1, depth)
+        self.staged_q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self.post_q: "queue.Queue" = queue.Queue()
+        self.tasks_posted = 0
+        self._stager = threading.Thread(
+            target=self._stage_loop, name="agent-stager", daemon=True
+        )
+        self._poster = threading.Thread(
+            target=self._post_loop, name="agent-poster", daemon=True
+        )
+
+    # ---- stager thread ----
+
+    def _stage_one(self, lease_id: str, task: Any) -> Optional[_Item]:
+        agent = self.agent
+        t0 = time.perf_counter()
+        # Shared resolution (Agent.resolve_task): malformed-task salvage and
+        # the UnknownOp shape are single-sourced with the serial loop.
+        job_id, op, payload, epoch, fn, resolve_error = agent.resolve_task(task)
+        if resolve_error is not None:
+            if job_id is None:
+                return None
+            return _Item(
+                lease_id, job_id, epoch, op, {}, None, t0,
+                status="failed", error=resolve_error,
+            )
+
+        item = _Item(
+            lease_id, job_id, epoch, op, payload,
+            agent._op_context(job_id), t0, fn=fn,
+        )
+        stage = getattr(fn, "stage", None)
+        if stage is None:
+            item.monolithic = True
+            return item
+        try:
+            phase, value = stage(payload, item.ctx)
+        except Exception as exc:  # noqa: BLE001 — same contract as run_task
+            item.status = "failed"
+            item.error = structured_error(exc)
+            agent.rate.log("exec", "stage raised", op=op, type=type(exc).__name__)
+            return item
+        if phase == "done":
+            item.result = value
+        else:
+            item.staged = value
+        return item
+
+    def _stage_loop(self) -> None:
+        agent = self.agent
+        try:
+            while agent.running:
+                try:
+                    leased = agent.lease_once()
+                except RuntimeError as exc:
+                    agent.rate.log("lease", str(exc))
+                    time.sleep(agent.config.agent.error_backoff_sec)
+                    continue
+                if leased is None:
+                    time.sleep(agent.config.agent.idle_sleep_sec)
+                    continue
+                lease_id, tasks = leased
+                for task in tasks:
+                    if not agent.running:
+                        break
+                    item = self._stage_one(lease_id, task)
+                    if item is not None:
+                        self._put_bounded(item)  # blocks at depth; backpressure
+        finally:
+            # The sentinel must reach the device loop even if this thread
+            # dies unexpectedly — a lost sentinel would leave the device
+            # thread blocked in get() forever, a hung agent holding the TPU.
+            self.staged_q.put(_STOP)
+
+    def _put_bounded(self, item: Any) -> None:
+        """Blocking put that still notices shutdown: if the device loop died
+        with the queue full, a plain put() would deadlock the stager."""
+        while True:
+            try:
+                self.staged_q.put(item, timeout=0.5)
+                return
+            except queue.Full:
+                if not self.agent.running:
+                    return  # drain aborted; lease TTL re-queues the task
+
+    # ---- device (calling) thread ----
+
+    def _execute_loop(self) -> None:
+        agent = self.agent
+        try:
+            while True:
+                item = self.staged_q.get()
+                if item is _STOP:
+                    break
+                if item.result is not None or item.status == "failed":
+                    self.post_q.put(item)
+                    continue
+                try:
+                    # profiled_call covers phased ops too — PROFILE_DIR
+                    # traces capture the device phase either way (§5.1).
+                    if item.monolithic:
+                        item.result = agent.profiled_call(
+                            item.op,
+                            lambda i=item: i.fn(i.payload, i.ctx),
+                        )
+                    else:
+                        item.executed = agent.profiled_call(
+                            item.op,
+                            lambda i=item: i.fn.execute(i.staged, i.ctx),
+                        )
+                except Exception as exc:  # noqa: BLE001 — op error → failed
+                    item.status = "failed"
+                    item.error = structured_error(exc)
+                    agent.rate.log("exec", "op raised", op=item.op,
+                                   type=type(exc).__name__)
+                self.post_q.put(item)
+        finally:
+            self.post_q.put(_STOP)  # same lost-sentinel guard as the stager
+
+    # ---- poster thread ----
+
+    def _post_loop(self) -> None:
+        agent = self.agent
+        # Own HTTP session: requests.Session is not thread-safe, and the
+        # stager is concurrently POSTing leases on the agent's session.
+        session = None
+        try:
+            import requests
+
+            session = requests.Session()
+        except Exception:  # noqa: BLE001 — stub sessions in tests
+            pass
+        while True:
+            item = self.post_q.get()
+            if item is _STOP:
+                break
+            try:
+                if item.executed is not None:
+                    item.result = item.fn.finalize(item.executed, item.ctx)
+            except Exception as exc:  # noqa: BLE001
+                item.status = "failed"
+                item.error = structured_error(exc)
+                item.result = None
+            duration_ms = (time.perf_counter() - item.t_start) * 1000.0
+            if isinstance(item.result, dict):
+                item.result.setdefault("duration_ms", duration_ms)
+                if item.ctx is not None and item.ctx.tags.get("timings"):
+                    item.result.setdefault("timings", item.ctx.tags["timings"])
+            agent.post_result(
+                item.lease_id, item.job_id, item.epoch, item.status,
+                result=item.result, error=item.error, session=session,
+            )
+            self.tasks_posted += 1
+            agent.tasks_done += 1
+            log("task done", op=item.op, job_id=item.job_id,
+                status=item.status, duration_ms=round(duration_ms, 3),
+                pipelined=True)
+
+    # ---- lifecycle ----
+
+    def run(self) -> None:
+        # The runtime must exist before the stager reads mesh metadata, and
+        # it must be built HERE: this is the device-owning thread.
+        if self.agent.runtime is None:
+            from agent_tpu.runtime.runtime import get_runtime
+
+            self.agent.runtime = get_runtime(self.agent.config.device)
+        log("pipelined drain up", depth=self.depth)
+        self._stager.start()
+        self._poster.start()
+        try:
+            self._execute_loop()   # device work stays on the caller's thread
+        finally:
+            self.agent.running = False
+            self._stager.join(timeout=30)
+            self._poster.join(timeout=30)
+        log("pipelined drain stopped", tasks_posted=self.tasks_posted)

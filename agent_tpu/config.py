@@ -110,6 +110,10 @@ class AgentConfig:
     tasks: Tuple[str, ...] = ("echo", "map_classify_tpu")
     labels: Dict[str, Any] = field(default_factory=dict)
     tpu_kind: str = "tpu-v5e"
+    # Host-side double buffering (agent/pipeline.py): depth of the staged-task
+    # queue between the stager thread and the device loop. 0 = serial loop.
+    # Single-host only; multi-host lockstep broadcast stays serial.
+    pipeline_depth: int = 2
 
     @staticmethod
     def from_env() -> "AgentConfig":
@@ -125,6 +129,7 @@ class AgentConfig:
             tasks=parse_tasks(env_str("TASKS", "echo,map_classify_tpu")),
             labels=parse_labels(os.environ.get("AGENT_LABELS", "")),
             tpu_kind=env_str("TPU_KIND", "tpu-v5e"),
+            pipeline_depth=max(0, env_int("PIPELINE_DEPTH", 2)),
         )
 
 

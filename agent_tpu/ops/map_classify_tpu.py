@@ -1,7 +1,7 @@
 """Classification on the TPU mesh — successor of the reference's Edge-TPU op.
 
 Capability parity with reference ``ops/map_classify_tpu.py:31-90`` +
-``CONTRACT.md:1-27``:
+``CONTRACT.md:1-27`` (full contract: ``map_classify_tpu.CONTRACT.md`` here):
 
 - Payload: required input (``input`` flat numeric list — now token ids — or the
   batched upgrades ``text``/``texts``), optional ``model_path``, ``topk``
@@ -17,6 +17,15 @@ batch into bucketed static shapes (``pad_batch``), the batch dim shards over
 the mesh ``dp`` axis, and a jit-compiled executable is cached per
 (model, batch-bucket, length-bucket) — reference handle-singleton semantics
 (``ops/_tpu_runtime.py:34-63``) generalized to a compiled-op cache.
+
+The op is **phase-split** for the pipelined drain (BASELINE.json "host-side
+double buffering"): :func:`stage` (pure host — payload validation, CSV shard
+read, fused tokenize+pad), :func:`execute` (device — params, compiled
+dispatch, fetch), :func:`finalize` (pure host — numpy → JSON-shaped result).
+``run`` composes all three, so monolithic callers see the classic contract;
+the agent's pipeline runs stage/finalize on worker threads and keeps every
+device touch in ``execute`` on the owning thread (single-owner invariant,
+SURVEY.md §5.2).
 
 Degraded mode is *better* than the reference's: the reference's fallback never
 computes (empty topk, ``CONTRACT.md:26`` "fallback handled elsewhere"); ours
@@ -122,35 +131,61 @@ def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List, str, bool]:
 MAX_BATCH = 8192
 
 
-def _run_on_runtime(
-    runtime, items: List, kind: str, model_id: str, cfg, k: int
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Classify ``items`` (token-id lists or raw texts, per ``kind``) →
-    (topk values [N, k], topk indices [N, k]).
+def _stage_chunks(dp: int, items: List, kind: str, cfg) -> List[Tuple]:
+    """Pure host: tokenize+pad ``items`` into device-ready arrays.
 
-    Top-k runs on device, fused into the forward executable: the host fetches
-    k probabilities per row, not [B, n_classes] logits — at bench shapes that
-    is a ~100× smaller device→host transfer. Chunks dispatch asynchronously
-    and are fetched after the loop, so host staging of chunk i+1 overlaps
-    device compute of chunk i. Text chunks tokenize+pad in one fused numpy
-    pass (``byte_encode_pad``).
+    Returns ``[(ids[B, L] wire-dtype, lengths[B] int32, n_real_rows), ...]``.
+    Host→device traffic is the per-task tax: ship uint16 ids (vocab 260 >
+    uint8) + one length per row; the compiled program rebuilds int32 ids and
+    the [B, L] mask on device — 4× less than int32 ids + int32 mask.
     """
-    import jax
-    import jax.numpy as jnp
-
-    from agent_tpu.models import encoder
     from agent_tpu.models.tokenizer import (
         DEFAULT_BUCKETS,
         byte_encode_pad,
         pad_batch,
     )
-    from agent_tpu.ops._model_common import batch_buckets, cfg_key, iter_chunks
+    from agent_tpu.ops._model_common import batch_buckets, iter_chunks
 
-    dp = runtime.axis_size("dp")
     # Length buckets must not exceed the position table (max_len).
     buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_len] or [cfg.max_len]
     bbuckets = batch_buckets(dp, MAX_BATCH)
+    # uint16 halves the upload but wraps ids ≥ 2^16 — only safe while the
+    # vocab fits (payload model_config may override vocab_size).
+    wire_dtype = np.uint16 if cfg.vocab_size <= (1 << 16) else np.int32
 
+    chunks: List[Tuple] = []
+    # Oversize batches run as extra device calls on the top bucket shape.
+    for chunk in iter_chunks(items, bbuckets[-1]):
+        if kind == "texts":
+            ids, lengths = byte_encode_pad(
+                chunk, buckets=buckets, batch_buckets=bbuckets,
+                max_len_cap=cfg.max_len,
+            )
+        else:
+            ids, _ = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
+            B, L = ids.shape
+            lengths = np.zeros(B, dtype=np.int32)
+            lengths[: len(chunk)] = [min(len(s), L) for s in chunk]
+        chunks.append((ids.astype(wire_dtype), lengths, len(chunk)))
+    return chunks
+
+
+def _execute_chunks(
+    runtime, chunks: List[Tuple], model_id: str, cfg, k: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Device phase: classify staged chunks → (topk values [N, k], indices).
+
+    Top-k runs on device, fused into the forward executable: the host fetches
+    k probabilities per row, not [B, n_classes] logits — at bench shapes that
+    is a ~100× smaller device→host transfer. Chunks dispatch asynchronously
+    and are fetched after the loop, so host staging of chunk i+1 overlaps
+    device compute of chunk i even without the pipeline.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from agent_tpu.models import encoder
+    from agent_tpu.ops._model_common import cfg_key
     from agent_tpu.parallel.shardings import encoder_param_specs
 
     # On a tp>1 mesh the weights land sharded (Megatron-style specs) and XLA
@@ -163,32 +198,18 @@ def _run_on_runtime(
     )
     attn_fn = runtime.attention_fn()  # ring over sp when the mesh has one
     pending: List[Tuple[Any, Any, int]] = []
-    # Oversize batches run as extra device calls on the top bucket shape.
-    for chunk in iter_chunks(items, bbuckets[-1]):
-        if kind == "texts":
-            ids, lengths = byte_encode_pad(
-                chunk, buckets=buckets, batch_buckets=bbuckets,
-                max_len_cap=cfg.max_len,
-            )
-            B, L = ids.shape
-        else:
-            ids, _ = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
-            B, L = ids.shape
-            lengths = np.zeros(B, dtype=np.int32)
-            lengths[: len(chunk)] = [min(len(s), L) for s in chunk]
-        # Host→device traffic is the per-task tax: ship uint16 ids (vocab
-        # 260 > uint8) + one length per row, and rebuild the int32 ids and
-        # the [B, L] mask on device — 4× less than int32 ids + int32 mask.
+    for ids, lengths, n in chunks:
+        B, L = ids.shape
 
         def build(L=L):
-            def run(p, i, n):
-                mask = (jnp.arange(L)[None, :] < n[:, None]).astype(jnp.int32)
+            def run_fwd(p, i, nlen):
+                mask = (jnp.arange(L)[None, :] < nlen[:, None]).astype(jnp.int32)
                 logits = encoder.forward(
                     p, i.astype(jnp.int32), mask, cfg, attn_fn=attn_fn
                 )
                 return encoder.topk_probs(logits, k)
 
-            return jax.jit(run)
+            return jax.jit(run_fwd)
 
         # k is fused into the executable, so a task stream alternating topk
         # values recompiles per (shape, k). Measured trade-off: splitting
@@ -198,15 +219,10 @@ def _run_on_runtime(
         fn = runtime.compiled(
             ("map_classify_tpu", model_id, B, L, k, cfg_key(cfg)), build
         )
-        # uint16 halves the upload but wraps ids ≥ 2^16 — only safe while the
-        # vocab fits (payload model_config may override vocab_size).
-        wire_dtype = np.uint16 if cfg.vocab_size <= (1 << 16) else np.int32
         vals, idx = fn(
-            params,
-            runtime.put_batch(ids.astype(wire_dtype)),
-            runtime.put_batch(lengths),
+            params, runtime.put_batch(ids), runtime.put_batch(lengths)
         )
-        pending.append((vals, idx, len(chunk)))
+        pending.append((vals, idx, n))
     all_vals = np.concatenate([np.asarray(v)[:n] for v, _, n in pending])
     all_idx = np.concatenate([np.asarray(i)[:n] for _, i, n in pending])
     return all_vals, all_idx
@@ -220,28 +236,123 @@ def _get_cpu_runtime():
         from agent_tpu.config import DeviceConfig
         from agent_tpu.runtime.runtime import TpuRuntime
 
+        # One device, dp=1: the degraded path must accept chunks staged for
+        # ANY primary mesh (every batch bucket divides 1), and production
+        # hosts expose a single cpu device anyway.
         _cpu_runtime = TpuRuntime(
-            config=DeviceConfig(tpu_disabled=True), devices=jax.devices("cpu")
+            config=DeviceConfig(tpu_disabled=True),
+            devices=jax.devices("cpu")[:1],
         )
     return _cpu_runtime
 
 
-@register_op("map_classify_tpu")
-def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+def stage(payload: Any, ctx: Optional[object] = None):
+    """Host-only phase. Returns ``("done", result)`` for immediate soft
+    results (bad input) or ``("staged", state)`` for :func:`execute`.
+
+    Thread-safe: touches no device state (the mesh shape read off an existing
+    runtime is host metadata). Shard-read and tokenize errors follow the
+    drain contract — ValueError → soft result, I/O / integrity errors raise.
+    """
     t0 = time.perf_counter()
     if not isinstance(payload, dict):
-        return bad_input("payload must be a dict")
+        return "done", bad_input("payload must be a dict")
 
     topk = payload.get("topk", DEFAULT_TOPK)
     if isinstance(topk, bool) or not isinstance(topk, int) or topk <= 0:
-        return bad_input("topk must be a positive int")
+        return "done", bad_input("topk must be a positive int")
     result_format = payload.get("result_format", "rows")
     if result_format not in ("rows", "columnar"):
-        return bad_input("result_format must be 'rows' or 'columnar'")
-    allow_fallback = bool(payload.get("allow_fallback", True))
-    model_id = _resolve_model_id(payload)
+        return "done", bad_input("result_format must be 'rows' or 'columnar'")
 
-    def _fail(reason: str) -> Dict[str, Any]:
+    try:
+        cfg = _get_cfg(payload)
+        items, kind, single = _collect_sequences(payload, cfg)
+    except ValueError as exc:
+        return "done", bad_input(str(exc))
+
+    # Batch buckets must divide the mesh that will execute them. The pipeline
+    # always injects a built runtime (so this is a host-side metadata read);
+    # standalone calls resolve the singleton here, on the owning thread. If
+    # no runtime can be had, dp=1 matches the CPU fallback execute will take.
+    try:
+        if ctx is not None and getattr(ctx, "require_runtime", None):
+            dp = ctx.require_runtime().axis_size("dp")
+        else:
+            from agent_tpu.runtime.runtime import get_runtime
+
+            dp = get_runtime().axis_size("dp")
+    except Exception:  # noqa: BLE001 — no backend ⇒ degraded path shapes
+        dp = 1
+    chunks = _stage_chunks(dp, items, kind, cfg)
+
+    state = {
+        "t0": t0,
+        "chunks": chunks,
+        "n_rows": len(items),
+        "cfg": cfg,
+        "k": min(topk, cfg.n_classes),  # clamp so lax.top_k stays legal
+        "model_id": _resolve_model_id(payload),
+        "result_format": result_format,
+        "allow_fallback": bool(payload.get("allow_fallback", True)),
+        "single": single,
+        "t_staged": time.perf_counter(),
+    }
+    return "staged", state
+
+
+def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, Any]:
+    """Device phase (owning thread only): run staged chunks on the mesh,
+    falling back to the CPU backend per the degraded-mode contract."""
+    # Stamped here, not at stage end: in pipelined mode the item may sit in
+    # the bounded queue between phases, and that wait must not count as
+    # device time (it shows up as queue_ms instead).
+    state["t_exec0"] = time.perf_counter()
+    model_id, cfg, k = state["model_id"], state["cfg"], state["k"]
+    fallback_reason = None
+    try:
+        if ctx is not None and getattr(ctx, "require_runtime", None):
+            runtime = ctx.require_runtime()
+        else:
+            from agent_tpu.runtime.runtime import get_runtime
+
+            runtime = get_runtime()
+        vals, idx = _execute_chunks(runtime, state["chunks"], model_id, cfg, k)
+        device = runtime.platform
+    except Exception as exc:  # noqa: BLE001 — any device failure → fallback path
+        if not state["allow_fallback"]:
+            raise
+        try:
+            runtime = _get_cpu_runtime()
+            vals, idx = _execute_chunks(runtime, state["chunks"], model_id, cfg, k)
+            device = runtime.platform
+            fallback_reason = f"{type(exc).__name__}: {exc}"
+        except Exception as cpu_exc:  # noqa: BLE001 — truly degraded
+            if not state["single"]:
+                # Batch/drain shards must FAIL (→ controller retry), not
+                # report a degraded empty result that silently drops every
+                # row of the shard; the reference's degraded contract is a
+                # single-row interactive shape (ref :22-28).
+                raise
+            state["degraded_reason"] = (
+                f"{type(exc).__name__}: {exc}; cpu retry: {cpu_exc}"
+            )
+            state["t_device"] = time.perf_counter()
+            return state
+    state.update(
+        vals=vals, idx=idx, device=device, fallback_reason=fallback_reason,
+        t_device=time.perf_counter(),
+    )
+    return state
+
+
+def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, Any]:
+    """Host serialization phase: numpy top-k → the JSON-shaped result. Safe
+    off the device thread (reads fetched arrays only)."""
+    t0, model_id = state["t0"], state["model_id"]
+    result_format = state["result_format"]
+
+    if "degraded_reason" in state:
         # Reference degraded shape (ref ops/map_classify_tpu.py:22-28),
         # carrying whichever empty result keys the requested format promises.
         out = {
@@ -249,7 +360,7 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
             "op": "map_classify_tpu",
             "model_path": model_id,
             "fallback": "cpu",
-            "reason": reason[:500],
+            "reason": state["degraded_reason"][:500],
             "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
         }
         if result_format == "columnar":
@@ -259,62 +370,28 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
             out["topk"] = []
         return out
 
-    try:
-        cfg = _get_cfg(payload)
-        items, kind, single = _collect_sequences(payload, cfg)
-    except ValueError as exc:
-        return bad_input(str(exc))
-    t_staged = time.perf_counter()
-
-    # Clamp k to the class count so lax.top_k stays legal for any payload.
-    k = min(topk, cfg.n_classes)
-    fallback_reason = None
-    try:
-        if ctx is not None and getattr(ctx, "require_runtime", None):
-            runtime = ctx.require_runtime()
-        else:
-            from agent_tpu.runtime.runtime import get_runtime
-
-            runtime = get_runtime()
-        vals, idx = _run_on_runtime(runtime, items, kind, model_id, cfg, k)
-        device = runtime.platform
-    except Exception as exc:  # noqa: BLE001 — any device failure → fallback path
-        if not allow_fallback:
-            raise
-        try:
-            runtime = _get_cpu_runtime()
-            vals, idx = _run_on_runtime(runtime, items, kind, model_id, cfg, k)
-            device = runtime.platform
-            fallback_reason = f"{type(exc).__name__}: {exc}"
-        except Exception as cpu_exc:  # noqa: BLE001 — truly degraded
-            if not single:
-                # Batch/drain shards must FAIL (→ controller retry), not
-                # report a degraded empty result that silently drops every
-                # row of the shard; the reference's degraded contract is a
-                # single-row interactive shape (ref :22-28).
-                raise
-            return _fail(f"{type(exc).__name__}: {exc}; cpu retry: {cpu_exc}")
-
-    t_device = time.perf_counter()
     if ctx is not None and hasattr(ctx, "tags"):
         # Per-stage trace (SURVEY.md §5.1): staging = payload → token rows
-        # (incl. shard read), device = pad + transfer + compute + fetch.
+        # (incl. shard read); queue = wait between phases (pipelined mode);
+        # device = params + transfer + compute + fetch.
         ctx.tags.setdefault("timings", {}).update(
-            stage_ms=round((t_staged - t0) * 1000.0, 3),
-            device_ms=round((t_device - t_staged) * 1000.0, 3),
+            stage_ms=round((state["t_staged"] - t0) * 1000.0, 3),
+            queue_ms=round((state["t_exec0"] - state["t_staged"]) * 1000.0, 3),
+            device_ms=round((state["t_device"] - state["t_exec0"]) * 1000.0, 3),
         )
 
+    vals, idx = state["vals"], state["idx"]
     out: Dict[str, Any] = {
         "ok": True,
         "op": "map_classify_tpu",
         "model_path": model_id,
-        "device": device,
-        "n_rows": len(items),
+        "device": state["device"],
+        "n_rows": state["n_rows"],
         "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
     }
-    if fallback_reason is not None:
+    if state["fallback_reason"] is not None:
         out["fallback"] = "cpu"
-        out["reason"] = fallback_reason
+        out["reason"] = state["fallback_reason"]
 
     if result_format == "columnar":
         # Drain-friendly wire shape: [N, k] index/score arrays instead of
@@ -328,6 +405,22 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
 
     per_row = topk_rows(vals, idx)
     out["topk"] = per_row[0]
-    if not single:
+    if not state["single"]:
         out["results"] = [{"topk": t} for t in per_row]
     return out
+
+
+@register_op("map_classify_tpu")
+def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    """Classic monolithic entry: stage → execute → finalize inline."""
+    phase, value = stage(payload, ctx)
+    if phase == "done":
+        return value
+    return finalize(execute(value, ctx), ctx)
+
+
+# Phase hooks for the pipelined drain (agent_tpu.agent.pipeline): the agent
+# discovers them via these attributes, so ops without phases run monolithic.
+run.stage = stage
+run.execute = execute
+run.finalize = finalize

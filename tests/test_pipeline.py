@@ -1,0 +1,172 @@
+"""Pipelined drain (VERDICT r2 item 2, BASELINE.json "host-side double
+buffering"): staging and posting overlap device compute; results match the
+serial loop exactly; device touches stay on the owning thread."""
+
+import threading
+import time
+
+import jax
+import pytest
+import requests
+
+from agent_tpu.agent.app import Agent
+from agent_tpu.config import AgentConfig, Config, DeviceConfig
+from agent_tpu.controller.core import Controller
+from agent_tpu.controller.server import ControllerServer
+from agent_tpu.runtime.runtime import TpuRuntime
+
+TINY = {
+    "d_model": 32, "n_heads": 4, "n_layers": 1, "d_ff": 64,
+    "max_len": 64, "dtype": "float32", "n_classes": 16,
+}
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    return TpuRuntime(
+        config=DeviceConfig(tpu_disabled=True, mesh_shape={"dp": 8}),
+        devices=jax.devices("cpu"),
+    )
+
+
+def _csv(tmp_path, n=64):
+    path = tmp_path / "rows.csv"
+    lines = ["id,text"]
+    for i in range(n):
+        lines.append(f'{i},"pipelined drain row {i} with text"')
+    path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    return str(path)
+
+
+def _drain_pipelined(controller, server, runtime, tasks=("map_classify_tpu",),
+                     depth=2):
+    """Run the pipelined agent until the controller drains, then stop it."""
+    cfg = Config(
+        agent=AgentConfig(
+            controller_url=server.url, agent_name="pipe",
+            tasks=tasks, idle_sleep_sec=0.0, pipeline_depth=depth,
+        )
+    )
+    agent = Agent(config=cfg, session=requests.Session(), runtime=runtime)
+    agent._profile = {"tier": "test"}
+
+    def watch():
+        deadline = time.time() + 120
+        while not controller.drained() and time.time() < deadline:
+            time.sleep(0.02)
+        agent.shutdown()
+
+    watcher = threading.Thread(target=watch, daemon=True)
+    watcher.start()
+    agent.run()  # picks the pipelined path (depth > 0, single host)
+    watcher.join(timeout=5)
+    return agent
+
+
+def test_pipelined_results_match_serial(runtime, tmp_path):
+    csv = _csv(tmp_path)
+    extra = {"text_field": "text", "allow_fallback": False,
+             "result_format": "columnar", "model_config": dict(TINY),
+             "topk": 3}
+
+    serial = Controller()
+    serial.submit_csv_job(csv, total_rows=64, shard_size=16,
+                          map_op="map_classify_tpu", extra_payload=extra)
+    with ControllerServer(serial) as server:
+        cfg = Config(agent=AgentConfig(
+            controller_url=server.url, agent_name="serial",
+            tasks=("map_classify_tpu",), idle_sleep_sec=0.0, pipeline_depth=0))
+        agent = Agent(config=cfg, session=requests.Session(), runtime=runtime)
+        agent._profile = {"tier": "test"}
+        while not serial.drained():
+            agent.step()
+
+    piped = Controller()
+    piped.submit_csv_job(csv, total_rows=64, shard_size=16,
+                         map_op="map_classify_tpu", extra_payload=extra)
+    with ControllerServer(piped) as server:
+        _drain_pipelined(piped, server, runtime)
+
+    assert piped.counts() == {"succeeded": 4}
+    for jid, want in serial.results().items():
+        start = serial.job(jid).payload["start_row"]
+        got = next(
+            r for j, r in piped.results().items()
+            if piped.job(j).payload["start_row"] == start
+        )
+        assert got["indices"] == want["indices"]
+        assert got["scores"] == want["scores"]
+        assert got["timings"]["device_ms"] > 0  # phase timings survive
+
+
+def test_pipelined_mixed_ops_and_errors(runtime, tmp_path):
+    """Monolithic ops (echo), soft errors, and hard errors all flow through
+    the pipeline with the serial loop's result contract."""
+    c = Controller()
+    ok_id = c.submit("map_classify_tpu",
+                     {"texts": ["row a", "row b"], "topk": 2,
+                      "model_config": dict(TINY), "allow_fallback": False})
+    echo_id = c.submit("echo", {"x": 42})
+    soft_id = c.submit("map_classify_tpu", {"topk": 0, "texts": ["x"]})
+    hard_id = c.submit("map_classify_tpu",
+                       {"source_uri": str(tmp_path / "missing.csv"),
+                        "start_row": 0, "shard_size": 8})
+    with ControllerServer(c) as server:
+        _drain_pipelined(c, server, runtime,
+                         tasks=("map_classify_tpu", "echo"))
+
+    assert c.job_snapshot(ok_id)["result"]["ok"] is True
+    assert c.job_snapshot(echo_id)["result"]["echo"] == {"x": 42}
+    assert c.job_snapshot(soft_id)["state"] == "succeeded"
+    assert c.job_snapshot(soft_id)["result"]["ok"] is False
+    hard = c.job_snapshot(hard_id)
+    assert hard["state"] == "failed"  # retried once, then stuck failed
+    assert hard["error"]["type"] in ("FileNotFoundError", "OSError")
+    assert hard["attempts"] == 2
+
+
+def test_pipelined_drain_is_graceful(runtime, tmp_path):
+    """Shutdown mid-drain: queued work drains (posted or TTL-requeued), the
+    threads join, nothing deadlocks, no task is double-reported."""
+    csv = _csv(tmp_path, n=96)
+    c = Controller(lease_ttl_sec=1.0)
+    c.submit_csv_job(csv, total_rows=96, shard_size=8,
+                     map_op="map_classify_tpu",
+                     extra_payload={"text_field": "text",
+                                    "model_config": dict(TINY),
+                                    "allow_fallback": False})
+    with ControllerServer(c) as server:
+        cfg = Config(agent=AgentConfig(
+            controller_url=server.url, agent_name="graceful",
+            tasks=("map_classify_tpu",), idle_sleep_sec=0.0, pipeline_depth=2))
+        agent = Agent(config=cfg, session=requests.Session(), runtime=runtime)
+        agent._profile = {"tier": "test"}
+
+        def stop_soon():
+            time.sleep(0.5)
+            agent.shutdown()
+
+        threading.Thread(target=stop_soon, daemon=True).start()
+        agent.run()
+        # Second agent finishes whatever the first one left (expired leases
+        # re-queue via TTL) — resumability is the graceful-drain contract.
+        c.sweep()
+        time.sleep(1.1)
+        c.sweep()
+        _drain_pipelined(c, server, runtime)
+    counts = c.counts()
+    assert counts.get("succeeded", 0) == 12 and "failed" not in counts
+
+
+def test_serial_loop_still_default_for_max_steps(runtime):
+    """run(max_steps=N) keeps the deterministic serial loop for tests."""
+    c = Controller()
+    c.submit("echo", {"v": 1})
+    with ControllerServer(c) as server:
+        cfg = Config(agent=AgentConfig(
+            controller_url=server.url, agent_name="serial",
+            tasks=("echo",), idle_sleep_sec=0.0, pipeline_depth=2))
+        agent = Agent(config=cfg, session=requests.Session(), runtime=runtime)
+        agent._profile = {"tier": "test"}
+        agent.run(max_steps=3)
+    assert c.counts() == {"succeeded": 1}
