@@ -36,11 +36,21 @@ from agent_tpu.models.layers import NEG_INF, dot_product_attention
 _LANES = 128  # VPU lane width; scratch last dims pad to this anyway
 
 # Below this key length the XLA dense path wins: its batched-matmul schedule
-# beats the kernel's per-(b,h) grid when the score matrix is small (measured
-# on v5e: dense 1.7x faster at Lk=128, parity ≈2k, flash 4.4x faster at 8k).
-# The kernel's advantage is not materializing [Lq, Lk] scores in HBM, which
-# only matters once that matrix is big.
+# beats the kernel's per-(b,h) grid when the score matrix is small. The
+# kernel's advantage is not materializing [Lq, Lk] scores in HBM, which only
+# matters once that matrix is big. Measured on v5e (RTT-amortized, d_head
+# 128): flash 3.7× at Lk=4k, >50× at 8k where the dense path's score
+# materialization thrashes HBM (450 ms/call vs 8.5 ms). With d_head ≤ 64 the
+# kernel's MXU contraction is underfilled (ratio 1.3–1.8×) — long-context
+# model configs here keep d_head at the 128 MXU tile (see bench.py).
 FLASH_MIN_KEY_LEN = 2048
+
+# Trace-time selection tally: ``flash_attention`` decides kernel-vs-dense while
+# the surrounding jit TRACES (the gate is static shape metadata), so these
+# counters tick once per compiled program, not per call. bench.py diffs them
+# around a warmup to *prove* which path a compiled executable contains —
+# "the bench exercises the Pallas kernel" becomes an assertion, not a belief.
+SELECTION_COUNTS = {"flash": 0, "dense": 0}
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
@@ -102,9 +112,11 @@ def flash_attention(
     ``interpret=None`` auto-selects interpreter mode off-TPU so the identical
     kernel is testable on the CPU mesh; pass False to require Mosaic.
 
-    Default 512×512 tiles measured best on v5e (scores tile = 1 MB VMEM);
-    at 8k context this kernel ran ~4.4× faster than the XLA dense path on a
-    v5e chip (which materializes the [Lq, Lk] scores in HBM).
+    Default 512×512 tiles measured best on v5e (scores tile = 1 MB VMEM).
+    Measured v5e per-call ratios vs the dense XLA path (which materializes
+    the [Lq, Lk] scores in HBM): 3.7× at 4k context, >50× at 8k, at
+    d_head 128 — see ``FLASH_MIN_KEY_LEN`` note and ``bench.py``'s
+    ``long_ctx`` leg, which records the ratio as a driver artifact.
     """
     from agent_tpu.models.layers import is_key_padding_mask
 
@@ -120,6 +132,7 @@ def flash_attention(
         and Lq % bq == 0
         and Lk % bk == 0
     )
+    SELECTION_COUNTS["flash" if supported else "dense"] += 1
     if not supported:
         return dot_product_attention(q, k, v, mask)
     if interpret is None:

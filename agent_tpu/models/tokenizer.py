@@ -39,7 +39,7 @@ SPECIAL_TOKENS = ("<pad>", "<bos>", "<eos>", "<unk>")
 # Default padding buckets: powers of two from 16 up. One compiled executable per
 # bucket per batch size — the executable cache stays small and recompiles stop
 # once the buckets are warm.
-DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
+DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 
 class ByteTokenizer:

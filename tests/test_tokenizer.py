@@ -47,7 +47,7 @@ def test_bucket_length():
     assert bucket_length(1) == 16
     assert bucket_length(16) == 16
     assert bucket_length(17) == 32
-    assert bucket_length(10_000) == 2048  # clamps to top bucket
+    assert bucket_length(10_000) == 4096  # clamps to top bucket
 
 
 def test_pad_batch_static_shapes():
