@@ -11,6 +11,12 @@ loop (SURVEY.md §5.8 two-tier design).
 """
 
 from agent_tpu.parallel.collectives import mesh_reduce_stats
+from agent_tpu.parallel.pipeline import encoder_forward_pp, pipeline_blocks
 from agent_tpu.parallel.ring import make_ring_attention
 
-__all__ = ["mesh_reduce_stats", "make_ring_attention"]
+__all__ = [
+    "mesh_reduce_stats",
+    "make_ring_attention",
+    "encoder_forward_pp",
+    "pipeline_blocks",
+]
