@@ -59,3 +59,63 @@ def iter_chunks(seqs: Sequence, max_chunk: int) -> Iterator[Sequence]:
     (which would allocate fewer rows than sequences and crash)."""
     for i in range(0, len(seqs), max_chunk):
         yield seqs[i : i + max_chunk]
+
+
+def validate_start_row(payload: Dict[str, Any]) -> int:
+    """``start_row`` as a non-negative int (0 when absent); ValueError — the
+    soft-error path — on anything else. Sink-mode shard files are named by
+    it, so a bad value must fail validation, not generate garbage names."""
+    raw = payload.get("start_row", 0)
+    if raw is None:
+        return 0
+    if isinstance(raw, bool) or not isinstance(raw, int) or raw < 0:
+        raise ValueError("start_row must be a non-negative int")
+    return raw
+
+
+def validate_output_uri(payload: Dict[str, Any]):
+    """Optional result sink: ``output_uri`` names a local directory the op
+    writes full per-row results to, posting only a small receipt back to the
+    controller. The at-scale drain pattern (BASELINE.json 10M-row job): row
+    payloads (10M summaries ≈ GBs) stream to disk next to the data instead of
+    accumulating in controller memory and the result journal.
+
+    Returns the validated directory (created if missing) or None; raises
+    ValueError (→ soft bad_input) when unusable.
+    """
+    uri = payload.get("output_uri")
+    if uri is None:
+        return None
+    if not isinstance(uri, str) or not uri:
+        raise ValueError("output_uri must be a non-empty directory path")
+    try:
+        os.makedirs(uri, exist_ok=True)
+    except OSError as exc:
+        raise ValueError(f"output_uri not creatable: {exc}") from exc
+    if not os.path.isdir(uri) or not os.access(uri, os.W_OK):
+        raise ValueError(f"output_uri not a writable directory: {uri}")
+    return uri
+
+
+def write_output_shard(
+    output_dir: str, op: str, start_row: int, rows: Iterator[Dict[str, Any]]
+) -> Tuple[str, int]:
+    """Write one shard's rows as JSONL → (path, n_rows). Line ``k`` holds
+    absolute dataset row ``start_row + k``.
+
+    Atomic (tmp + ``os.replace``) so a controller retry of the same shard
+    (idempotent shard addressing, SURVEY.md §5.4) can never leave a torn
+    file — the retry simply rewrites the identical content.
+    """
+    import json
+
+    path = os.path.join(output_dir, f"{op}_rows_{start_row:012d}.jsonl")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    n = 0
+    with open(tmp, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row, separators=(",", ":")))
+            f.write("\n")
+            n += 1
+    os.replace(tmp, path)
+    return path, n

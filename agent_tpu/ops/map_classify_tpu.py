@@ -268,6 +268,13 @@ def stage(payload: Any, ctx: Optional[object] = None):
     try:
         cfg = _get_cfg(payload)
         items, kind, single = _collect_sequences(payload, cfg)
+        from agent_tpu.ops._model_common import (
+            validate_output_uri,
+            validate_start_row,
+        )
+
+        output_dir = validate_output_uri(payload)
+        start_row = validate_start_row(payload)
     except ValueError as exc:
         return "done", bad_input(str(exc))
 
@@ -296,6 +303,8 @@ def stage(payload: Any, ctx: Optional[object] = None):
         "result_format": result_format,
         "allow_fallback": bool(payload.get("allow_fallback", True)),
         "single": single,
+        "output_dir": output_dir,
+        "start_row": start_row,
         "t_staged": time.perf_counter(),
     }
     return "staged", state
@@ -392,6 +401,21 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
     if state["fallback_reason"] is not None:
         out["fallback"] = "cpu"
         out["reason"] = state["fallback_reason"]
+
+    if state["output_dir"] is not None:
+        # Result-sink mode: full per-row top-k goes to disk; the wire carries
+        # a receipt. At drain scale the controller must not hold row payloads.
+        from agent_tpu.ops._model_common import write_output_shard
+
+        idx_l = np.asarray(idx).tolist()
+        val_l = np.round(np.asarray(vals), 6).tolist()
+        path, n = write_output_shard(
+            state["output_dir"], "map_classify_tpu", state["start_row"],
+            ({"indices": i, "scores": s} for i, s in zip(idx_l, val_l)),
+        )
+        out["output_path"] = path
+        out["rows_written"] = n
+        return out
 
     if result_format == "columnar":
         # Drain-friendly wire shape: [N, k] index/score arrays instead of

@@ -185,6 +185,17 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     cfg = _get_cfg(payload)
     max_new = min(max_new, cfg.max_tgt_len)
 
+    from agent_tpu.ops._model_common import (
+        validate_output_uri,
+        validate_start_row,
+    )
+
+    try:
+        output_dir = validate_output_uri(payload)
+        start_row = validate_start_row(payload)
+    except ValueError as exc:
+        return bad_input(str(exc))
+
     from agent_tpu.config import OpsConfig
 
     # stage = payload → texts (incl. shard read); runtime acquisition and
@@ -240,6 +251,19 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         "num_beams": num_beams,
         "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
     }
+    if output_dir is not None:
+        # Result-sink mode (see classify): summaries go to disk, the wire
+        # carries a receipt — a 10M-row summarize drain posts ~KBs/shard,
+        # not the row payloads.
+        from agent_tpu.ops._model_common import write_output_shard
+
+        path, n = write_output_shard(
+            output_dir, "map_summarize", start_row,
+            ({"summary": s} for s in summaries),
+        )
+        out["output_path"] = path
+        out["rows_written"] = n
+        return out
     out["summary"] = summaries[0]
     if not single:
         out["summaries"] = summaries

@@ -35,17 +35,23 @@ def _padded_len(n: int, multiple: int) -> int:
 def _build_stats_fn(runtime) -> Any:
     mesh = runtime.mesh
 
-    def local_stats(x: jax.Array, m: jax.Array):
-        s = lax.psum(jnp.sum(x * m), "dp")
-        mn = lax.pmin(jnp.min(jnp.where(m > 0, x, jnp.inf)), "dp")
-        mx = lax.pmax(jnp.max(jnp.where(m > 0, x, -jnp.inf)), "dp")
-        return s, mn, mx
+    def local_stats(hi: jax.Array, lo: jax.Array, m: jax.Array):
+        # Double-single sum: hi/lo are the f32 split of the f64 inputs (hi =
+        # round(v), lo = v - hi), so the sum of BOTH partial sums recovers the
+        # f64 values' sum up to f32 *accumulation* error — the input-cast
+        # error of a plain f32 path is gone entirely. The two partials
+        # combine on the host in f64 (see mesh_reduce_stats).
+        s_hi = lax.psum(jnp.sum(hi * m), "dp")
+        s_lo = lax.psum(jnp.sum(lo * m), "dp")
+        mn = lax.pmin(jnp.min(jnp.where(m > 0, hi, jnp.inf)), "dp")
+        mx = lax.pmax(jnp.max(jnp.where(m > 0, hi, -jnp.inf)), "dp")
+        return s_hi, s_lo, mn, mx
 
     fn = jax.shard_map(
         local_stats,
         mesh=mesh,
-        in_specs=(P("dp"), P("dp")),
-        out_specs=(P(), P(), P()),
+        in_specs=(P("dp"), P("dp"), P("dp")),
+        out_specs=(P(), P(), P(), P()),
     )
     return jax.jit(fn)
 
@@ -55,14 +61,30 @@ def mesh_reduce_stats(runtime, values: Sequence[float]) -> Dict[str, Any]:
 
     Returns the ``risk_accumulate`` result fields (reference
     ``ops/risk_accumulate.py:70-77`` shape); the caller adds ``ok``/timing.
+
+    Numerics contract: inputs ship as a double-single (hi/lo f32) pair, so
+    there is NO input-cast error vs the host ``math.fsum`` path; the residual
+    is f32 *accumulation* error of the shard-local sums, worst-case relative
+    ``n · 2⁻²⁴`` and in practice far smaller (XLA reduces in trees). The
+    controller-side merge path stays exact (``risk_accumulate`` host fsum);
+    this device path trades that last-ulp exactness for on-chip reduction
+    over ICI.
     """
     n = len(values)
     if n == 0:
         return {"count": 0, "sum": 0.0, "mean": 0.0, "min": None, "max": None}
     dp = runtime.axis_size("dp")
     size = _padded_len(n, dp)
-    x = np.zeros(size, dtype=np.float32)
-    x[:n] = np.asarray(values, dtype=np.float32)
+    v64 = np.zeros(size, dtype=np.float64)
+    v64[:n] = np.asarray(values, dtype=np.float64)
+    hi = v64.astype(np.float32)
+    # Values beyond f32 range cast to ±inf; their residual would be ∓inf and
+    # the recombined sum inf + -inf = NaN. Zero the residual instead so the
+    # overflow stays a detectable inf, same as a plain f32 cast.
+    with np.errstate(invalid="ignore"):
+        lo = np.where(
+            np.isfinite(hi), v64 - hi.astype(np.float64), 0.0
+        ).astype(np.float32)
     m = np.zeros(size, dtype=np.float32)
     m[:n] = 1.0
 
@@ -70,10 +92,15 @@ def mesh_reduce_stats(runtime, values: Sequence[float]) -> Dict[str, Any]:
         ("mesh_reduce_stats", size, dp), lambda: _build_stats_fn(runtime)
     )
     sharding = runtime.sharding("dp")
-    s, mn, mx = fn(jax.device_put(x, sharding), jax.device_put(m, sharding))
+    s_hi, s_lo, mn, mx = fn(
+        jax.device_put(hi, sharding),
+        jax.device_put(lo, sharding),
+        jax.device_put(m, sharding),
+    )
     # count is exact host knowledge (len), not a float32 mask-psum: a mask sum
-    # loses integer exactness past 2^24 elements.
-    total = float(s)
+    # loses integer exactness past 2^24 elements. The hi/lo partials combine
+    # here in f64 — the whole point of shipping the split.
+    total = float(s_hi) + float(s_lo)
     return {
         "count": n,
         "sum": total,

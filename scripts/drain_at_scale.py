@@ -1,0 +1,196 @@
+"""At-scale mixed drain: the literal BASELINE.json north-star job shape.
+
+Drains an N-row (default 10M) CSV through BOTH model ops — every row
+classified AND summarized — via the real controller/HTTP/agent/pipeline
+path, with per-row results streaming to JSONL sinks (``output_uri``) so the
+controller carries receipts, not payloads.
+
+Run on the TPU host:
+
+    python scripts/drain_at_scale.py --rows 10000000 \
+        --workdir /tmp/drain10m --report DRAIN_AT_SCALE.json
+
+The report JSON records wall time, per-op rows/sec and device-busy seconds,
+shard counts, retry/failure counts, and sink row totals — the artifact
+PARITY.md cites for the "drains a 10M-row classify+summarize job" sentence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CLASSIFY_SHARD = 8192
+SUMMARIZE_SHARD = 1024
+SUMMARIZE_MAX_NEW = 32
+
+
+def build_csv(path: str, n_rows: int) -> None:
+    if os.path.exists(path):
+        return
+    tmp = path + ".tmp"
+    t0 = time.perf_counter()
+    with open(tmp, "w") as f:
+        f.write("id,text,risk\n")
+        for i in range(n_rows):
+            f.write(
+                f'{i},"drain record {i} with a payload of text to classify '
+                f'and summarize",{i % 89}\n'
+            )
+    os.replace(tmp, path)
+    print(f"csv built: {n_rows} rows, "
+          f"{os.path.getsize(path) / 1e6:.0f} MB, "
+          f"{time.perf_counter() - t0:.0f}s", flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_000_000)
+    ap.add_argument("--workdir", default="/tmp/drain_at_scale")
+    ap.add_argument("--report", default="DRAIN_AT_SCALE.json")
+    ap.add_argument("--progress-sec", type=float, default=60.0)
+    args = ap.parse_args()
+
+    import requests
+
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.agent.pipeline import PipelineRunner
+    from agent_tpu.config import AgentConfig, Config
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+    from agent_tpu.runtime.runtime import get_runtime
+
+    os.makedirs(args.workdir, exist_ok=True)
+    csv_path = os.path.join(args.workdir, f"drain_{args.rows}.csv")
+    classify_out = os.path.join(args.workdir, "classify_out")
+    summarize_out = os.path.join(args.workdir, "summarize_out")
+    build_csv(csv_path, args.rows)
+
+    runtime = get_runtime()
+    controller = Controller(lease_ttl_sec=600.0)
+    t_start = time.perf_counter()
+    with ControllerServer(controller) as server:
+        cfg = Config(
+            agent=AgentConfig(
+                controller_url=server.url,
+                agent_name="drain-at-scale",
+                tasks=("map_classify_tpu", "map_summarize"),
+                idle_sleep_sec=0.0,
+            )
+        )
+        agent = Agent(config=cfg, session=requests.Session(), runtime=runtime)
+        agent._profile = {"tier": "at-scale"}
+
+        controller.submit_csv_job(
+            csv_path, total_rows=args.rows, shard_size=CLASSIFY_SHARD,
+            map_op="map_classify_tpu",
+            extra_payload={
+                "text_field": "text", "allow_fallback": False,
+                "output_uri": classify_out,
+            },
+        )
+        controller.submit_csv_job(
+            csv_path, total_rows=args.rows, shard_size=SUMMARIZE_SHARD,
+            map_op="map_summarize",
+            extra_payload={
+                "text_field": "text", "allow_fallback": False,
+                "max_length": SUMMARIZE_MAX_NEW, "output_uri": summarize_out,
+            },
+        )
+        n_shards = sum(controller.counts().values())
+        print(f"submitted {n_shards} shards "
+              f"({args.rows} rows x 2 ops)", flush=True)
+
+        done = {}
+
+        def watch():
+            last = 0.0
+            while not controller.drained():
+                time.sleep(1.0)
+                now = time.perf_counter()
+                if now - last >= args.progress_sec:
+                    last = now
+                    c = controller.counts()
+                    done_n = c.get("succeeded", 0) + c.get("failed", 0)
+                    print(
+                        f"[{now - t_start:7.0f}s] {json.dumps(c)} "
+                        f"({done_n}/{n_shards} shards)",
+                        flush=True,
+                    )
+            done["wall"] = time.perf_counter() - t_start
+            agent.running = False
+
+        threading.Thread(target=watch, daemon=True).start()
+        PipelineRunner(agent, depth=2).run()
+        wall = done.get("wall", time.perf_counter() - t_start)
+
+        counts = controller.counts()
+        busy_ms = {"map_classify_tpu": 0.0, "map_summarize": 0.0}
+        rows_written = {"map_classify_tpu": 0, "map_summarize": 0}
+        not_ok = 0
+        for r in controller.results().values():
+            if not isinstance(r, dict) or r.get("ok") is not True:
+                not_ok += 1
+                continue
+            op = r.get("op") or (
+                "map_summarize" if "output_path" in r and "map_summarize"
+                in r.get("output_path", "") else None
+            )
+            if op in busy_ms:
+                device_ms = r.get("timings", {}).get("device_ms")
+                busy_ms[op] += float(
+                    device_ms if device_ms is not None
+                    else r.get("elapsed_ms", 0.0)
+                )
+                rows_written[op] += int(r.get("rows_written", 0))
+
+    report = {
+        "rows": args.rows,
+        "ops": ["map_classify_tpu", "map_summarize"],
+        "wall_s": round(wall, 1),
+        "shards": n_shards,
+        "counts": counts,
+        "non_ok_results": not_ok,
+        "total_rows_per_sec": round(2 * args.rows / wall, 1),
+        "classify": {
+            "shard_size": CLASSIFY_SHARD,
+            "rows_written": rows_written["map_classify_tpu"],
+            "device_busy_s": round(busy_ms["map_classify_tpu"] / 1e3, 1),
+            "rows_per_device_sec": round(
+                args.rows / (busy_ms["map_classify_tpu"] / 1e3), 1
+            ) if busy_ms["map_classify_tpu"] else None,
+        },
+        "summarize": {
+            "shard_size": SUMMARIZE_SHARD,
+            "max_new_tokens": SUMMARIZE_MAX_NEW,
+            "rows_written": rows_written["map_summarize"],
+            "device_busy_s": round(busy_ms["map_summarize"] / 1e3, 1),
+            "rows_per_device_sec": round(
+                args.rows / (busy_ms["map_summarize"] / 1e3), 1
+            ) if busy_ms["map_summarize"] else None,
+        },
+        "platform": runtime.platform,
+        "n_chips": runtime.n_devices,
+    }
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report), flush=True)
+
+    ok = (
+        counts.get("failed", 0) == 0
+        and not_ok == 0
+        and rows_written["map_classify_tpu"] == args.rows
+        and rows_written["map_summarize"] == args.rows
+    )
+    print("DRAIN", "OK" if ok else "FAILED", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

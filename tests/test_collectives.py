@@ -45,6 +45,30 @@ def test_mesh_reduce_reuses_executable(rt):
     assert after["misses"] == before + 1  # one compile for the shared bucket
 
 
+def test_mesh_reduce_double_single_beats_f32_cast(rt):
+    """The hi/lo transport must recover precision a plain f32 cast loses:
+    values whose fractional part vanishes in f32 at magnitude 2^26."""
+    base = 2.0**26
+    values = [base + 0.1875 * (i % 8) for i in range(1000)]
+    want = math.fsum(values)
+    out = mesh_reduce_stats(rt, values)
+    # Plain f32 input cast would drop every fractional part (0.1875·k < ulp
+    # at 2^26), erring by ~656 absolute; the split path must stay within f32
+    # accumulation noise of the exact sum.
+    naive_err = abs(math.fsum(float(np.float32(v)) for v in values) - want)
+    assert naive_err > 100.0  # the failure mode is real at this magnitude
+    assert abs(out["sum"] - want) < naive_err / 50
+    assert out["sum"] == pytest.approx(want, rel=1e-7)
+
+
+def test_mesh_reduce_f32_overflow_stays_inf_not_nan(rt):
+    """Values beyond f32 range must surface as a detectable inf (plain-cast
+    behavior), never as NaN from an inf + -inf hi/lo recombination."""
+    out = mesh_reduce_stats(rt, [1e39] + [1.0] * 1023)
+    assert np.isinf(out["sum"]) and out["sum"] > 0
+    assert not np.isnan(out["mean"])
+
+
 def test_risk_accumulate_device_path_agrees_with_host(rt):
     from agent_tpu.ops.risk_accumulate import run
     from agent_tpu.runtime import OpContext

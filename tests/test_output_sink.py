@@ -1,0 +1,108 @@
+"""Result-sink mode (``output_uri``): full per-row results go to JSONL on
+disk, the wire carries a receipt — the at-scale drain pattern that keeps a
+10M-row job's payloads out of controller memory."""
+
+import json
+import os
+
+import pytest
+
+from agent_tpu.ops import get_op
+from agent_tpu.runtime.context import OpContext
+from agent_tpu.runtime.runtime import get_runtime
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return OpContext(runtime=get_runtime())
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_classify_sink_receipt_and_rows(ctx, tmp_path):
+    classify = get_op("map_classify_tpu")
+    payload = {
+        "texts": [f"sink row {i}" for i in range(5)],
+        "topk": 3,
+        "allow_fallback": False,
+    }
+    full = classify(dict(payload), ctx)
+    out = classify(dict(payload, output_uri=str(tmp_path)), ctx)
+    assert out["ok"] is True
+    assert out["rows_written"] == 5
+    # Receipt, not payload: none of the heavy row fields on the wire.
+    assert "topk" not in out and "results" not in out
+    assert "indices" not in out and "scores" not in out
+    rows = _read_jsonl(out["output_path"])
+    assert len(rows) == 5
+    # File content matches the wire-format results row for row.
+    for row, wire in zip(rows, full["results"]):
+        assert row["indices"] == [e["index"] for e in wire["topk"]]
+        got = [round(e["score"], 6) for e in wire["topk"]]
+        assert row["scores"] == pytest.approx(got, abs=1e-6)
+
+
+def test_classify_sink_names_by_start_row(ctx, tmp_path):
+    classify = get_op("map_classify_tpu")
+    out = classify(
+        {"texts": ["a", "b"], "output_uri": str(tmp_path),
+         "start_row": 8192, "allow_fallback": False},
+        ctx,
+    )
+    assert out["output_path"].endswith("map_classify_tpu_rows_000000008192.jsonl")
+
+
+def test_classify_sink_retry_is_idempotent(ctx, tmp_path):
+    classify = get_op("map_classify_tpu")
+    payload = {"texts": ["same shard"], "output_uri": str(tmp_path),
+               "allow_fallback": False}
+    first = classify(dict(payload), ctx)
+    second = classify(dict(payload), ctx)  # controller retry of the shard
+    assert first["output_path"] == second["output_path"]
+    assert _read_jsonl(first["output_path"]) == _read_jsonl(second["output_path"])
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+
+
+def test_classify_sink_bad_uri_soft_error(ctx, tmp_path):
+    classify = get_op("map_classify_tpu")
+    a_file = tmp_path / "not_a_dir"
+    a_file.write_text("x")
+    out = classify(
+        {"texts": ["row"], "output_uri": str(a_file)}, ctx
+    )
+    assert out["ok"] is False
+    assert "output_uri" in out["error"]
+
+
+@pytest.mark.parametrize("bad", ["abc", -1, 2.5, True])
+def test_bad_start_row_is_soft_error(ctx, tmp_path, bad):
+    """Malformed start_row must be a soft {ok: false} (sink files are named
+    by it), not a raised exception the controller would retry forever."""
+    classify = get_op("map_classify_tpu")
+    out = classify(
+        {"texts": ["row"], "output_uri": str(tmp_path), "start_row": bad}, ctx
+    )
+    assert out["ok"] is False and "start_row" in out["error"]
+    summarize = get_op("map_summarize")
+    out = summarize(
+        {"texts": ["row to sum"], "output_uri": str(tmp_path),
+         "start_row": bad, "max_length": 4},
+        ctx,
+    )
+    assert out["ok"] is False and "start_row" in out["error"]
+
+
+def test_summarize_sink_receipt_and_rows(ctx, tmp_path):
+    summarize = get_op("map_summarize")
+    payload = {"texts": ["summarize this " * 4, "and this " * 4],
+               "max_length": 8}
+    full = summarize(dict(payload), ctx)
+    out = summarize(dict(payload, output_uri=str(tmp_path)), ctx)
+    assert out["ok"] is True
+    assert out["rows_written"] == 2
+    assert "summaries" not in out and "summary" not in out
+    rows = _read_jsonl(out["output_path"])
+    assert [r["summary"] for r in rows] == full["summaries"]
