@@ -61,6 +61,61 @@ def iter_chunks(seqs: Sequence, max_chunk: int) -> Iterator[Sequence]:
         yield seqs[i : i + max_chunk]
 
 
+def resolve_dp(ctx) -> int:
+    """The mesh ``dp`` extent the op's batches must divide — a host-side
+    metadata read. The pipeline always injects a built runtime; standalone
+    calls resolve the singleton here, on the owning thread. No backend at
+    all ⇒ 1, matching the degraded CPU path's shapes."""
+    try:
+        if ctx is not None and getattr(ctx, "require_runtime", None):
+            return ctx.require_runtime().axis_size("dp")
+        from agent_tpu.runtime.runtime import get_runtime
+
+        return get_runtime().axis_size("dp")
+    except Exception:  # noqa: BLE001 — no backend ⇒ dp=1 shapes
+        return 1
+
+
+def stage_text_chunks(
+    dp: int,
+    texts: Sequence[str],
+    *,
+    max_len: int,
+    vocab_size: int,
+    max_batch: int,
+    add_bos: bool = False,
+    add_eos: bool = False,
+) -> List[Tuple]:
+    """Pure host: fused byte-tokenize+pad ``texts`` into device-ready
+    ``[(ids[B, L] wire-dtype, lengths[B] int32, n_real_rows), ...]`` chunks —
+    the shared staging hot path of both model ops.
+
+    Host→device traffic is the per-task tax: ship uint16 ids (vocab 260 >
+    uint8) + one length per row; the compiled program rebuilds int32 ids and
+    the [B, L] mask on device — 4× less than int32 ids + int32 mask. uint16
+    wraps ids ≥ 2^16, so it is only used while the vocab fits (a payload
+    ``model_config`` may override ``vocab_size``). Length buckets are capped
+    at ``max_len`` so they never exceed the model's position table; batch
+    buckets are multiples of ``dp`` so the batch dim always divides the mesh.
+    """
+    import numpy as np
+
+    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS, byte_encode_pad
+
+    buckets = [b for b in DEFAULT_BUCKETS if b <= max_len] or [max_len]
+    bbuckets = batch_buckets(dp, max_batch)
+    wire_dtype = np.uint16 if vocab_size <= (1 << 16) else np.int32
+    chunks: List[Tuple] = []
+    # Oversize batches run as extra device calls on the top bucket shape.
+    for chunk in iter_chunks(texts, bbuckets[-1]):
+        ids, lengths = byte_encode_pad(
+            chunk, buckets=buckets, batch_buckets=bbuckets,
+            max_len_cap=max_len, add_bos=add_bos, add_eos=add_eos,
+        )
+        chunks.append((ids.astype(wire_dtype), lengths, len(chunk)))
+    return chunks
+
+
 def validate_start_row(payload: Dict[str, Any]) -> int:
     """``start_row`` as a non-negative int (0 when absent); ValueError — the
     soft-error path — on anything else. Sink-mode shard files are named by

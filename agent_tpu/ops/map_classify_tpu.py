@@ -132,40 +132,35 @@ MAX_BATCH = 8192
 
 
 def _stage_chunks(dp: int, items: List, kind: str, cfg) -> List[Tuple]:
-    """Pure host: tokenize+pad ``items`` into device-ready arrays.
+    """Pure host: tokenize+pad ``items`` into device-ready
+    ``[(ids[B, L] wire-dtype, lengths[B] int32, n_real_rows), ...]``.
 
-    Returns ``[(ids[B, L] wire-dtype, lengths[B] int32, n_real_rows), ...]``.
-    Host→device traffic is the per-task tax: ship uint16 ids (vocab 260 >
-    uint8) + one length per row; the compiled program rebuilds int32 ids and
-    the [B, L] mask on device — 4× less than int32 ids + int32 mask.
+    Text rows go through the shared fused tokenize+pad hot path
+    (``_model_common.stage_text_chunks`` — wire format documented there);
+    pre-tokenized ``input`` rows (v0 contract) pad here.
     """
-    from agent_tpu.models.tokenizer import (
-        DEFAULT_BUCKETS,
-        byte_encode_pad,
-        pad_batch,
+    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS, pad_batch
+    from agent_tpu.ops._model_common import (
+        batch_buckets,
+        iter_chunks,
+        stage_text_chunks,
     )
-    from agent_tpu.ops._model_common import batch_buckets, iter_chunks
 
+    if kind == "texts":
+        return stage_text_chunks(
+            dp, items, max_len=cfg.max_len, vocab_size=cfg.vocab_size,
+            max_batch=MAX_BATCH,
+        )
     # Length buckets must not exceed the position table (max_len).
     buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_len] or [cfg.max_len]
     bbuckets = batch_buckets(dp, MAX_BATCH)
-    # uint16 halves the upload but wraps ids ≥ 2^16 — only safe while the
-    # vocab fits (payload model_config may override vocab_size).
     wire_dtype = np.uint16 if cfg.vocab_size <= (1 << 16) else np.int32
-
     chunks: List[Tuple] = []
-    # Oversize batches run as extra device calls on the top bucket shape.
     for chunk in iter_chunks(items, bbuckets[-1]):
-        if kind == "texts":
-            ids, lengths = byte_encode_pad(
-                chunk, buckets=buckets, batch_buckets=bbuckets,
-                max_len_cap=cfg.max_len,
-            )
-        else:
-            ids, _ = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
-            B, L = ids.shape
-            lengths = np.zeros(B, dtype=np.int32)
-            lengths[: len(chunk)] = [min(len(s), L) for s in chunk]
+        ids, _ = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
+        B, L = ids.shape
+        lengths = np.zeros(B, dtype=np.int32)
+        lengths[: len(chunk)] = [min(len(s), L) for s in chunk]
         chunks.append((ids.astype(wire_dtype), lengths, len(chunk)))
     return chunks
 
@@ -278,20 +273,10 @@ def stage(payload: Any, ctx: Optional[object] = None):
     except ValueError as exc:
         return "done", bad_input(str(exc))
 
-    # Batch buckets must divide the mesh that will execute them. The pipeline
-    # always injects a built runtime (so this is a host-side metadata read);
-    # standalone calls resolve the singleton here, on the owning thread. If
-    # no runtime can be had, dp=1 matches the CPU fallback execute will take.
-    try:
-        if ctx is not None and getattr(ctx, "require_runtime", None):
-            dp = ctx.require_runtime().axis_size("dp")
-        else:
-            from agent_tpu.runtime.runtime import get_runtime
+    # Batch buckets must divide the mesh that will execute them.
+    from agent_tpu.ops._model_common import resolve_dp
 
-            dp = get_runtime().axis_size("dp")
-    except Exception:  # noqa: BLE001 — no backend ⇒ degraded path shapes
-        dp = 1
-    chunks = _stage_chunks(dp, items, kind, cfg)
+    chunks = _stage_chunks(resolve_dp(ctx), items, kind, cfg)
 
     state = {
         "t0": t0,

@@ -14,13 +14,21 @@ The decode itself is ``models.seq2seq.greedy_generate``: one compiled program,
 host-side ``model.generate`` beam loop (ref ``:52-59``). SUMMARIZE_FORCE_CPU is
 still honored as a kill-switch (ref ``:10``) but defaults off: BASELINE.json's
 north star is zero CPU-side model execution.
+
+Like ``map_classify_tpu``, the op is **phase-split** for the pipelined drain:
+:func:`stage` (host — validation, shard read, fused tokenize+pad),
+:func:`execute` (device — params, compiled decode, token fetch),
+:func:`finalize` (host — detokenize, sink write, result shape). The summarize
+leg of an at-scale drain therefore overlaps next-shard tokenization and
+result posting with device decode; ``run`` composes the phases for
+monolithic callers.
 """
 
 from __future__ import annotations
 
 import os
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -30,7 +38,7 @@ from agent_tpu.utils.errors import bad_input
 DEFAULT_MODEL_ID = "summarize-default"
 DEFAULT_MAX_LENGTH = 130
 
-# One-shot guard for the default-inversion notice in run(): the framework
+# One-shot guard for the default-inversion notice in stage(): the framework
 # default (device execution) is the INVERSE of the reference's CPU-on default,
 # and that must be visible in operational logs of processes that actually run
 # summarize (only those — hence here, not in config.py).
@@ -61,24 +69,29 @@ def _build_params(model_id: str, cfg):
 MAX_BATCH = 1024
 
 
-def _generate(runtime, texts: List[str], model_id: str, cfg,
-              max_new: int, num_beams: int = 1) -> Tuple[List[str], str]:
+def _stage_chunks(dp: int, texts: List[str], cfg) -> List:
+    """Shared fused tokenize+pad (``_model_common.stage_text_chunks``),
+    BOS/EOS added for the seq2seq encoder."""
+    from agent_tpu.ops._model_common import stage_text_chunks
+
+    return stage_text_chunks(
+        dp, texts, max_len=cfg.max_src_len, vocab_size=cfg.vocab_size,
+        max_batch=MAX_BATCH, add_bos=True, add_eos=True,
+    )
+
+
+def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
+                   max_new: int, num_beams: int) -> List[np.ndarray]:
+    """Device phase: decode staged chunks → per-chunk token arrays [n, T].
+
+    Chunks dispatch asynchronously and are fetched after the loop, so host
+    staging of chunk i+1 overlaps device decode of chunk i even without the
+    pipeline (same pattern as classify's ``_execute_chunks``).
+    """
     import jax
 
     from agent_tpu.models import seq2seq
-    from agent_tpu.models.tokenizer import (
-        DEFAULT_BUCKETS,
-        ByteTokenizer,
-        byte_encode_pad,
-    )
-    from agent_tpu.ops._model_common import batch_buckets, cfg_key, iter_chunks
-
-    tok = ByteTokenizer()
-    dp = runtime.axis_size("dp")
-    # Length buckets must not exceed the position table (max_src_len).
-    buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_src_len] or [cfg.max_src_len]
-    bbuckets = batch_buckets(dp, MAX_BATCH)
-
+    from agent_tpu.ops._model_common import cfg_key
     from agent_tpu.parallel.shardings import seq2seq_param_specs
 
     # tp>1 mesh → weights land sharded, same serving-path TP as classify.
@@ -87,14 +100,9 @@ def _generate(runtime, texts: List[str], model_id: str, cfg,
         lambda: _build_params(model_id, cfg),
         specs=seq2seq_param_specs(cfg),
     )
-    summaries: List[str] = []
     attn_fn = runtime.attention_fn()  # ring over sp for the encoder pass
-    for chunk in iter_chunks(texts, bbuckets[-1]):
-        # Fused tokenize+pad (one numpy pass per row, classify's hot path).
-        ids, lengths = byte_encode_pad(
-            chunk, buckets=buckets, batch_buckets=bbuckets,
-            max_len_cap=cfg.max_src_len, add_bos=True, add_eos=True,
-        )
+    pending = []
+    for ids, lengths, n in chunks:
         B, Ls = ids.shape
 
         # Lengths-on-wire like classify: ship uint16 ids + one length per
@@ -122,22 +130,19 @@ def _generate(runtime, texts: List[str], model_id: str, cfg,
             ("map_summarize", model_id, B, Ls, max_new, num_beams, cfg_key(cfg)),
             build,
         )
-        wire_dtype = np.uint16 if cfg.vocab_size <= (1 << 16) else np.int32
         toks, _ = fn(
-            params,
-            runtime.put_batch(ids.astype(wire_dtype)),
-            runtime.put_batch(lengths),
+            params, runtime.put_batch(ids), runtime.put_batch(lengths)
         )
-        toks = np.asarray(toks)[: len(chunk)]
-        summaries.extend(tok.decode([t for t in row if t > 0]) for row in toks)
-    return summaries, runtime.platform
+        pending.append((toks, n))
+    return [np.asarray(toks)[:n] for toks, n in pending]
 
 
-@register_op("map_summarize")
-def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+def stage(payload: Any, ctx: Optional[object] = None):
+    """Host-only phase: validation, shard read, tokenize+pad. Returns
+    ``("done", result)`` for soft errors or ``("staged", state)``."""
     t0 = time.perf_counter()
     if not isinstance(payload, dict):
-        return bad_input("payload must be a dict")
+        return "done", bad_input("payload must be a dict")
 
     texts = payload.get("texts")
     single = texts is None and "source_uri" not in payload
@@ -152,7 +157,7 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         try:
             texts = read_shard_texts(payload)
         except ValueError as exc:
-            return bad_input(str(exc))
+            return "done", bad_input(str(exc))
         # Messy data is normal in drains: blank cells get an empty summary
         # (overwritten after generation) instead of failing the shard or
         # emitting model output for no input — the payload 'texts' path
@@ -163,27 +168,23 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     elif single:
         text = payload.get("text")
         if not isinstance(text, str) or not text:
-            return bad_input("payload requires a non-empty 'text' string")
+            return "done", bad_input("payload requires a non-empty 'text' string")
         texts = [text]
     elif not isinstance(texts, list) or not texts or not all(
         isinstance(t, str) and t for t in texts
     ):
-        return bad_input("texts must be a non-empty list of non-empty strings")
+        return "done", bad_input("texts must be a non-empty list of non-empty strings")
 
     max_new = payload.get("max_length", DEFAULT_MAX_LENGTH)
     if isinstance(max_new, bool) or not isinstance(max_new, int) or max_new <= 0:
-        return bad_input("max_length must be a positive int")
+        return "done", bad_input("max_length must be a positive int")
 
     # Beam search opt-in (the reference always decoded with num_beams=4,
     # reference ops/map_summarize.py:57; greedy default keeps the fast path).
     num_beams = payload.get("num_beams", 1)
     if isinstance(num_beams, bool) or not isinstance(num_beams, int) or \
             not 1 <= num_beams <= 16:
-        return bad_input("num_beams must be an int in [1, 16]")
-
-    model_id = _resolve_model_id(payload)
-    cfg = _get_cfg(payload)
-    max_new = min(max_new, cfg.max_tgt_len)
+        return "done", bad_input("num_beams must be an int in [1, 16]")
 
     from agent_tpu.ops._model_common import (
         validate_output_uri,
@@ -194,14 +195,13 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         output_dir = validate_output_uri(payload)
         start_row = validate_start_row(payload)
     except ValueError as exc:
-        return bad_input(str(exc))
+        return "done", bad_input(str(exc))
+
+    model_id = _resolve_model_id(payload)
+    cfg = _get_cfg(payload)
+    max_new = min(max_new, cfg.max_tgt_len)
 
     from agent_tpu.config import OpsConfig
-
-    # stage = payload → texts (incl. shard read); runtime acquisition and
-    # beyond is device time — same attribution as map_classify_tpu so the
-    # shared timings schema means one thing across ops.
-    t_staged = time.perf_counter()
 
     # The typed config is authoritative (its default is the single source;
     # standalone calls read the env through OpsConfig.from_env).
@@ -222,7 +222,34 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
             "summarize runs on the device backend by default "
             "(the reference defaulted to CPU; SUMMARIZE_FORCE_CPU=1 forces CPU)"
         )
-    if ops_cfg.summarize_force_cpu:
+
+    # Batch buckets must divide the executing mesh. Force-CPU always
+    # executes on the 1-device CPU runtime → dp=1.
+    from agent_tpu.ops._model_common import resolve_dp
+
+    dp = 1 if ops_cfg.summarize_force_cpu else resolve_dp(ctx)
+
+    state = {
+        "t0": t0,
+        "chunks": _stage_chunks(dp, texts, cfg),
+        "empty_rows": empty_rows,
+        "single": single,
+        "max_new": max_new,
+        "num_beams": num_beams,
+        "model_id": model_id,
+        "cfg": cfg,
+        "force_cpu": ops_cfg.summarize_force_cpu,
+        "output_dir": output_dir,
+        "start_row": start_row,
+        "t_staged": time.perf_counter(),
+    }
+    return "staged", state
+
+
+def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, Any]:
+    """Device phase (owning thread only): compiled decode of staged chunks."""
+    state["t_exec0"] = time.perf_counter()
+    if state["force_cpu"]:
         from agent_tpu.ops.map_classify_tpu import _get_cpu_runtime
 
         runtime = _get_cpu_runtime()
@@ -233,38 +260,78 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
 
         runtime = get_runtime()
 
-    summaries, device = _generate(
-        runtime, texts, model_id, cfg, max_new, num_beams=num_beams
+    state["token_chunks"] = _decode_chunks(
+        runtime, state["chunks"], state["model_id"], state["cfg"],
+        state["max_new"], state["num_beams"],
     )
-    for i in empty_rows:
+    state["device"] = runtime.platform
+    state["t_device"] = time.perf_counter()
+    return state
+
+
+def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, Any]:
+    """Host phase: detokenize fetched token rows, write the sink, shape the
+    result. Safe off the device thread (reads numpy arrays only)."""
+    from agent_tpu.models.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    summaries: List[str] = []
+    for toks in state["token_chunks"]:
+        summaries.extend(tok.decode([t for t in row if t > 0]) for row in toks)
+    for i in state["empty_rows"]:
         summaries[i] = ""  # no input → no summary, not model noise
+
     if ctx is not None and hasattr(ctx, "tags"):
+        # Same timings schema as classify: stage = payload → token rows;
+        # queue = wait between phases (pipelined mode); device = params +
+        # transfer + decode + fetch. Detokenize lands in the result's total.
         ctx.tags.setdefault("timings", {}).update(
-            stage_ms=round((t_staged - t0) * 1000.0, 3),
-            device_ms=round((time.perf_counter() - t_staged) * 1000.0, 3),
+            stage_ms=round((state["t_staged"] - state["t0"]) * 1000.0, 3),
+            queue_ms=round(
+                (state["t_exec0"] - state["t_staged"]) * 1000.0, 3
+            ),
+            device_ms=round(
+                (state["t_device"] - state["t_exec0"]) * 1000.0, 3
+            ),
         )
 
     out: Dict[str, Any] = {
         "ok": True,
-        "device": device,
-        "model": model_id,
-        "num_beams": num_beams,
-        "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
+        "device": state["device"],
+        "model": state["model_id"],
+        "num_beams": state["num_beams"],
+        "elapsed_ms": (time.perf_counter() - state["t0"]) * 1000.0,
     }
-    if output_dir is not None:
+    if state["output_dir"] is not None:
         # Result-sink mode (see classify): summaries go to disk, the wire
         # carries a receipt — a 10M-row summarize drain posts ~KBs/shard,
         # not the row payloads.
         from agent_tpu.ops._model_common import write_output_shard
 
         path, n = write_output_shard(
-            output_dir, "map_summarize", start_row,
+            state["output_dir"], "map_summarize", state["start_row"],
             ({"summary": s} for s in summaries),
         )
         out["output_path"] = path
         out["rows_written"] = n
         return out
     out["summary"] = summaries[0]
-    if not single:
+    if not state["single"]:
         out["summaries"] = summaries
     return out
+
+
+@register_op("map_summarize")
+def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    """Classic monolithic entry: stage → execute → finalize inline."""
+    phase, value = stage(payload, ctx)
+    if phase == "done":
+        return value
+    return finalize(execute(value, ctx), ctx)
+
+
+# Phase hooks for the pipelined drain (agent_tpu.agent.pipeline): the agent
+# discovers them via these attributes, so ops without phases run monolithic.
+run.stage = stage
+run.execute = execute
+run.finalize = finalize

@@ -99,6 +99,61 @@ def test_pipelined_results_match_serial(runtime, tmp_path):
         assert got["timings"]["device_ms"] > 0  # phase timings survive
 
 
+TINY_S2S = {
+    "d_model": 32, "n_heads": 4, "n_enc_layers": 1, "n_dec_layers": 1,
+    "d_ff": 64, "max_src_len": 64, "max_tgt_len": 16, "dtype": "float32",
+}
+
+
+def test_pipelined_summarize_matches_serial_with_sink(runtime, tmp_path):
+    """The summarize phase split: pipelined drain output (via JSONL sink)
+    must equal the serial monolithic run row for row."""
+    import json
+
+    csv = _csv(tmp_path, n=32)
+    sink = tmp_path / "sink"
+
+    def extra(out_dir):
+        return {"text_field": "text", "max_length": 6,
+                "model_config": dict(TINY_S2S), "output_uri": str(out_dir)}
+
+    serial = Controller()
+    serial.submit_csv_job(csv, total_rows=32, shard_size=8,
+                          map_op="map_summarize",
+                          extra_payload=extra(sink / "serial"))
+    with ControllerServer(serial) as server:
+        cfg = Config(agent=AgentConfig(
+            controller_url=server.url, agent_name="serial",
+            tasks=("map_summarize",), idle_sleep_sec=0.0, pipeline_depth=0))
+        agent = Agent(config=cfg, session=requests.Session(), runtime=runtime)
+        agent._profile = {"tier": "test"}
+        while not serial.drained():
+            agent.step()
+
+    piped = Controller()
+    piped.submit_csv_job(csv, total_rows=32, shard_size=8,
+                         map_op="map_summarize",
+                         extra_payload=extra(sink / "piped"))
+    with ControllerServer(piped) as server:
+        _drain_pipelined(piped, server, runtime, tasks=("map_summarize",))
+
+    assert piped.counts() == {"succeeded": 4}
+    for r in piped.results().values():
+        # Receipt on the wire; phase timings prove the split engaged.
+        assert r["rows_written"] == 8 and "summaries" not in r
+        assert r["timings"]["device_ms"] > 0
+        assert "queue_ms" in r["timings"]
+
+    def rows(d):
+        out = []
+        for p in sorted((sink / d).iterdir()):
+            out += [json.loads(ln) for ln in p.read_text().splitlines()]
+        return out
+
+    assert rows("piped") == rows("serial")
+    assert len(rows("piped")) == 32
+
+
 def test_pipelined_mixed_ops_and_errors(runtime, tmp_path):
     """Monolithic ops (echo), soft errors, and hard errors all flow through
     the pipeline with the serial loop's result contract."""
