@@ -76,6 +76,18 @@ def resolve_dp(ctx) -> int:
         return 1
 
 
+def length_buckets_for(max_len: int) -> List[int]:
+    """Length buckets capped at ``max_len`` (never exceeding the model's
+    position table), with ``max_len`` itself as the top bucket when the
+    standard powers of two don't reach it — so a full-length row is always
+    representable instead of silently truncating to the largest power."""
+    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS
+
+    buckets = [b for b in DEFAULT_BUCKETS if b < max_len]
+    buckets.append(max_len)
+    return buckets
+
+
 def stage_text_chunks(
     dp: int,
     texts: Sequence[str],
@@ -85,33 +97,41 @@ def stage_text_chunks(
     max_batch: int,
     add_bos: bool = False,
     add_eos: bool = False,
+    encode_pad=None,
 ) -> List[Tuple]:
-    """Pure host: fused byte-tokenize+pad ``texts`` into device-ready
+    """Pure host: tokenize+pad ``texts`` into device-ready
     ``[(ids[B, L] wire-dtype, lengths[B] int32, n_real_rows), ...]`` chunks —
-    the shared staging hot path of both model ops.
+    the shared staging scaffolding of both model ops and both vocab families.
+
+    ``encode_pad(chunk, length_buckets, batch_buckets) -> (ids, lengths)``
+    supplies the tokenizer (e.g. a checkpoint's wordpiece vocab); the default
+    is the fused byte path (``byte_encode_pad``).
 
     Host→device traffic is the per-task tax: ship uint16 ids (vocab 260 >
     uint8) + one length per row; the compiled program rebuilds int32 ids and
     the [B, L] mask on device — 4× less than int32 ids + int32 mask. uint16
     wraps ids ≥ 2^16, so it is only used while the vocab fits (a payload
-    ``model_config`` may override ``vocab_size``). Length buckets are capped
-    at ``max_len`` so they never exceed the model's position table; batch
-    buckets are multiples of ``dp`` so the batch dim always divides the mesh.
+    ``model_config`` may override ``vocab_size``). Length buckets come from
+    :func:`length_buckets_for`; batch buckets are multiples of ``dp`` so the
+    batch dim always divides the mesh.
     """
     import numpy as np
 
-    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS, byte_encode_pad
+    from agent_tpu.models.tokenizer import byte_encode_pad
 
-    buckets = [b for b in DEFAULT_BUCKETS if b <= max_len] or [max_len]
+    buckets = length_buckets_for(max_len)
     bbuckets = batch_buckets(dp, max_batch)
     wire_dtype = np.uint16 if vocab_size <= (1 << 16) else np.int32
+    if encode_pad is None:
+        def encode_pad(chunk, lb, bb):
+            return byte_encode_pad(
+                chunk, buckets=lb, batch_buckets=bb,
+                max_len_cap=max_len, add_bos=add_bos, add_eos=add_eos,
+            )
     chunks: List[Tuple] = []
     # Oversize batches run as extra device calls on the top bucket shape.
     for chunk in iter_chunks(texts, bbuckets[-1]):
-        ids, lengths = byte_encode_pad(
-            chunk, buckets=buckets, batch_buckets=bbuckets,
-            max_len_cap=max_len, add_bos=add_bos, add_eos=add_eos,
-        )
+        ids, lengths = encode_pad(chunk, buckets, bbuckets)
         chunks.append((ids.astype(wire_dtype), lengths, len(chunk)))
     return chunks
 

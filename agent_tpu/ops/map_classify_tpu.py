@@ -58,15 +58,59 @@ def _get_cfg(payload: Dict[str, Any]):
     return config_from_payload(payload, EncoderConfig)
 
 
+def _resolve_family(model_id: str) -> str:
+    """``model_path`` pointing at a local HF checkpoint directory serves the
+    pretrained-BERT family; anything else is the in-house encoder (model id
+    or ``.npz`` artifact). The pretrained serving story of the reference
+    (``ops/_tpu_runtime.py:23-31``), TPU-native."""
+    from agent_tpu.models import bert
+
+    return "bert" if bert.is_hf_dir(model_id) else "encoder"
+
+
+# The only model_config fields a payload may override for a checkpoint
+# model: serving controls. Structural fields (num_layers, hidden_size, ...)
+# are the checkpoint's — an override there would desync the staged config
+# from the actual weights.
+_BERT_SERVING_OVERRIDES = ("dtype", "num_labels")
+
+
+def _get_bert_cfg(model_id: str, payload: Dict[str, Any]):
+    """BertConfig from the checkpoint's config.json; payload ``model_config``
+    may override only the serving controls (``dtype``, ``num_labels``)."""
+    import os as _os
+
+    from agent_tpu.models.bert import BertConfig
+
+    overrides = payload.get("model_config")
+    allowed = {}
+    if isinstance(overrides, dict):
+        allowed = {
+            k: v for k, v in overrides.items()
+            if k in _BERT_SERVING_OVERRIDES
+        }
+    return BertConfig.from_hf_json(
+        _os.path.join(model_id, "config.json"), **allowed
+    )
+
+
 def _resolve_model_id(payload: Dict[str, Any]) -> str:
     from agent_tpu.ops._model_common import resolve_model_id
 
     return resolve_model_id(payload, "TPU_MODEL_PATH", DEFAULT_MODEL_ID)
 
 
-def _build_params(model_id: str, cfg):
+def _build_params(model_id: str, cfg, family: str = "encoder"):
     import os
 
+    if family == "bert":
+        from agent_tpu.models import bert
+
+        # Same overrides as the staged cfg so the head matches num_labels.
+        _, params = bert.load_hf_dir(
+            model_id, dtype=cfg.dtype, num_labels=cfg.num_labels
+        )
+        return params
     from agent_tpu.models import encoder
 
     if model_id.endswith(".npz") and os.path.exists(model_id):
@@ -131,28 +175,40 @@ def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List, str, bool]:
 MAX_BATCH = 8192
 
 
-def _stage_chunks(dp: int, items: List, kind: str, cfg) -> List[Tuple]:
+def _stage_chunks(dp: int, items: List, kind: str, cfg,
+                  family: str = "encoder", model_id: str = "") -> List[Tuple]:
     """Pure host: tokenize+pad ``items`` into device-ready
     ``[(ids[B, L] wire-dtype, lengths[B] int32, n_real_rows), ...]``.
 
     Text rows go through the shared fused tokenize+pad hot path
-    (``_model_common.stage_text_chunks`` — wire format documented there);
-    pre-tokenized ``input`` rows (v0 contract) pad here.
+    (``_model_common.stage_text_chunks`` — wire format documented there) for
+    the byte-vocab encoder family, or the checkpoint's wordpiece vocab for
+    the BERT family; pre-tokenized ``input`` rows (v0 contract) pad here.
     """
-    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS, pad_batch
+    from agent_tpu.models.tokenizer import pad_batch
     from agent_tpu.ops._model_common import (
         batch_buckets,
         iter_chunks,
+        length_buckets_for,
         stage_text_chunks,
     )
 
     if kind == "texts":
+        encode_pad = None
+        if family == "bert":
+            from agent_tpu.models import bert
+
+            tok = bert.hf_wordpiece(model_id)
+
+            def encode_pad(chunk, lb, bb):
+                return bert.encode_pad_batch(tok, chunk, cfg.max_len, bb, lb)
+
         return stage_text_chunks(
             dp, items, max_len=cfg.max_len, vocab_size=cfg.vocab_size,
-            max_batch=MAX_BATCH,
+            max_batch=MAX_BATCH, encode_pad=encode_pad,
         )
     # Length buckets must not exceed the position table (max_len).
-    buckets = [b for b in DEFAULT_BUCKETS if b <= cfg.max_len] or [cfg.max_len]
+    buckets = length_buckets_for(cfg.max_len)
     bbuckets = batch_buckets(dp, MAX_BATCH)
     wire_dtype = np.uint16 if cfg.vocab_size <= (1 << 16) else np.int32
     chunks: List[Tuple] = []
@@ -166,7 +222,8 @@ def _stage_chunks(dp: int, items: List, kind: str, cfg) -> List[Tuple]:
 
 
 def _execute_chunks(
-    runtime, chunks: List[Tuple], model_id: str, cfg, k: int
+    runtime, chunks: List[Tuple], model_id: str, cfg, k: int,
+    family: str = "encoder",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Device phase: classify staged chunks → (topk values [N, k], indices).
 
@@ -181,15 +238,23 @@ def _execute_chunks(
 
     from agent_tpu.models import encoder
     from agent_tpu.ops._model_common import cfg_key
-    from agent_tpu.parallel.shardings import encoder_param_specs
+    from agent_tpu.parallel.shardings import bert_param_specs, encoder_param_specs
+
+    if family == "bert":
+        from agent_tpu.models import bert as model_mod
+
+        specs = bert_param_specs(cfg)
+    else:
+        model_mod = encoder
+        specs = encoder_param_specs(cfg)
 
     # On a tp>1 mesh the weights land sharded (Megatron-style specs) and XLA
     # inserts the tp collectives in the forward — the serving path for models
     # that exceed one chip's HBM, not just the train path.
     params = runtime.get_params(
-        f"{model_id}#encoder#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}",
-        lambda: _build_params(model_id, cfg),
-        specs=encoder_param_specs(cfg),
+        f"{model_id}#{family}#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}",
+        lambda: _build_params(model_id, cfg, family),
+        specs=specs,
     )
     attn_fn = runtime.attention_fn()  # ring over sp when the mesh has one
     pending: List[Tuple[Any, Any, int]] = []
@@ -199,7 +264,7 @@ def _execute_chunks(
         def build(L=L):
             def run_fwd(p, i, nlen):
                 mask = (jnp.arange(L)[None, :] < nlen[:, None]).astype(jnp.int32)
-                logits = encoder.forward(
+                logits = model_mod.forward(
                     p, i.astype(jnp.int32), mask, cfg, attn_fn=attn_fn
                 )
                 return encoder.topk_probs(logits, k)
@@ -212,7 +277,8 @@ def _execute_chunks(
         # round-trip every call (-15% bench throughput); jobs use one topk,
         # so the fused form wins.
         fn = runtime.compiled(
-            ("map_classify_tpu", model_id, B, L, k, cfg_key(cfg)), build
+            ("map_classify_tpu", model_id, family, B, L, k, cfg_key(cfg)),
+            build,
         )
         vals, idx = fn(
             params, runtime.put_batch(ids), runtime.put_batch(lengths)
@@ -260,8 +326,16 @@ def stage(payload: Any, ctx: Optional[object] = None):
     if result_format not in ("rows", "columnar"):
         return "done", bad_input("result_format must be 'rows' or 'columnar'")
 
+    model_id = _resolve_model_id(payload)
+    family = _resolve_family(model_id)
     try:
-        cfg = _get_cfg(payload)
+        # Checkpoint-integrity problems (unreadable config.json, missing
+        # vocab) raise past this handler on purpose: they fail the shard for
+        # retry rather than soft-dropping it as caller error.
+        cfg = (
+            _get_bert_cfg(model_id, payload) if family == "bert"
+            else _get_cfg(payload)
+        )
         items, kind, single = _collect_sequences(payload, cfg)
         from agent_tpu.ops._model_common import (
             validate_output_uri,
@@ -276,7 +350,9 @@ def stage(payload: Any, ctx: Optional[object] = None):
     # Batch buckets must divide the mesh that will execute them.
     from agent_tpu.ops._model_common import resolve_dp
 
-    chunks = _stage_chunks(resolve_dp(ctx), items, kind, cfg)
+    chunks = _stage_chunks(
+        resolve_dp(ctx), items, kind, cfg, family=family, model_id=model_id
+    )
 
     state = {
         "t0": t0,
@@ -284,7 +360,8 @@ def stage(payload: Any, ctx: Optional[object] = None):
         "n_rows": len(items),
         "cfg": cfg,
         "k": min(topk, cfg.n_classes),  # clamp so lax.top_k stays legal
-        "model_id": _resolve_model_id(payload),
+        "model_id": model_id,
+        "family": family,
         "result_format": result_format,
         "allow_fallback": bool(payload.get("allow_fallback", True)),
         "single": single,
@@ -311,14 +388,20 @@ def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, An
             from agent_tpu.runtime.runtime import get_runtime
 
             runtime = get_runtime()
-        vals, idx = _execute_chunks(runtime, state["chunks"], model_id, cfg, k)
+        vals, idx = _execute_chunks(
+            runtime, state["chunks"], model_id, cfg, k,
+            family=state["family"],
+        )
         device = runtime.platform
     except Exception as exc:  # noqa: BLE001 — any device failure → fallback path
         if not state["allow_fallback"]:
             raise
         try:
             runtime = _get_cpu_runtime()
-            vals, idx = _execute_chunks(runtime, state["chunks"], model_id, cfg, k)
+            vals, idx = _execute_chunks(
+                runtime, state["chunks"], model_id, cfg, k,
+                family=state["family"],
+            )
             device = runtime.platform
             fallback_reason = f"{type(exc).__name__}: {exc}"
         except Exception as cpu_exc:  # noqa: BLE001 — truly degraded

@@ -75,6 +75,41 @@ def encoder_param_specs(cfg) -> Params:
     }
 
 
+def bert_param_specs(cfg) -> Params:
+    """PartitionSpec pytree matching ``models.bert.from_state_dict``.
+
+    Same Megatron column/row pattern as the in-house encoder: q/k/v and the
+    FFN input are column-parallel, the output projections row-parallel (one
+    psum per block), vocab-dim sharding for the embedding table; LayerNorms
+    and the small pooler/head replicate their biases per ``_dense_specs``.
+    """
+    blk = {
+        "attn": {
+            "q": _dense_specs(col=True),
+            "k": _dense_specs(col=True),
+            "v": _dense_specs(col=True),
+            "o": _dense_specs(col=False),
+            "ln": _ln_specs(),
+        },
+        "ffn": {
+            "i": _dense_specs(col=True),
+            "o": _dense_specs(col=False),
+            "ln": _ln_specs(),
+        },
+    }
+    return {
+        "embed": {
+            "word": P("tp", None),
+            "pos": P(),
+            "type": P(),
+            "ln": _ln_specs(),
+        },
+        "layers": [dict(blk) for _ in range(cfg.num_layers)],
+        "pooler": _dense_specs(col=True),
+        "head": _dense_specs(col=False),
+    }
+
+
 def seq2seq_param_specs(cfg) -> Params:
     """PartitionSpec pytree matching ``models.seq2seq.init_params(cfg)``."""
     return {
