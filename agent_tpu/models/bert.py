@@ -77,6 +77,12 @@ class BertConfig:
             raise RuntimeError(
                 f"unreadable checkpoint config.json at {path}: {exc}"
             ) from exc
+        if hf.get("model_type") not in (None, "bert"):
+            raise RuntimeError(
+                f"not a BERT checkpoint (model_type={hf.get('model_type')!r}"
+                " — map_classify_tpu serves model_type=bert; map_summarize "
+                "serves BART)"
+            )
         fields = dict(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -303,6 +309,35 @@ def hf_wordpiece(path: str):
     return tok
 
 
+def _is_cjk(cp: int) -> bool:
+    """HF BasicTokenizer's CJK ranges (each char becomes its own word)."""
+    return (
+        0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+        or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+        or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+        or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F
+    )
+
+
+def basic_normalize(text: str, strip_accents: bool) -> str:
+    """HF ``BasicTokenizer`` text normalization: accent stripping (NFD +
+    drop combining marks — on by default when ``do_lower_case``) and CJK
+    characters spaced out so each is one word. Without this, 'café' would
+    miss the vocab and encode as [UNK] where transformers finds 'cafe'."""
+    import unicodedata
+
+    if strip_accents:
+        text = "".join(
+            c for c in unicodedata.normalize("NFD", text)
+            if unicodedata.category(c) != "Mn"
+        )
+    if any(_is_cjk(ord(c)) for c in text):
+        text = "".join(
+            f" {c} " if _is_cjk(ord(c)) else c for c in text
+        )
+    return text
+
+
 def encode_pad_batch(
     tok, texts, max_len: int, batch_buckets, length_buckets
 ) -> Tuple[np.ndarray, np.ndarray]:
@@ -318,7 +353,10 @@ def encode_pad_batch(
     if cls_id is None or sep_id is None:
         raise ValueError("vocab.txt lacks [CLS]/[SEP] tokens")
     rows = [
-        [cls_id] + tok.encode(t)[: max_len - 2] + [sep_id] for t in texts
+        [cls_id]
+        + tok.encode(basic_normalize(t, tok.lowercase))[: max_len - 2]
+        + [sep_id]
+        for t in texts
     ]
     longest = max(len(r) for r in rows)
     L = bucket_length(min(longest, max_len), length_buckets)

@@ -1,0 +1,117 @@
+"""GPT-2-style byte-level BPE — the tokenizer family of BART/RoBERTa
+checkpoints (``vocab.json`` + ``merges.txt``).
+
+Implements the exact algorithm of the reference tokenizers (byte→unicode
+remap, regex pre-tokenization, greedy lowest-rank merges) so ids match
+``transformers``' slow GPT2/BART tokenizer token for token — differential
+tested in ``tests/test_bart.py``. Pure Python + ``regex``; no network, no
+tokenizers-library dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from functools import lru_cache
+from typing import Dict, List, Tuple
+
+import regex as re
+
+# GPT-2's pre-tokenization pattern, verbatim.
+_PAT = re.compile(
+    r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
+)
+
+
+@lru_cache(maxsize=1)
+def bytes_to_unicode() -> Dict[int, str]:
+    """The GPT-2 reversible byte→printable-unicode table."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+class ByteLevelBPE:
+    """Encoder/decoder over a GPT-2 vocab.json + merges.txt pair."""
+
+    def __init__(self, vocab: Dict[str, int],
+                 merges: List[Tuple[str, str]]) -> None:
+        self.vocab = vocab
+        self.inv_vocab = {v: k for k, v in vocab.items()}
+        self.ranks = {pair: i for i, pair in enumerate(merges)}
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {c: b for b, c in self.byte_encoder.items()}
+        self._cache: Dict[str, List[str]] = {}
+        self._cache_lock = threading.Lock()
+
+    @classmethod
+    def from_dir(cls, path: str) -> "ByteLevelBPE":
+        with open(os.path.join(path, "vocab.json"), encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges: List[Tuple[str, str]] = []
+        with open(os.path.join(path, "merges.txt"), encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#version"):
+                    continue
+                a, _, b = line.partition(" ")
+                merges.append((a, b))
+        return cls(vocab, merges)
+
+    def _bpe(self, token: str) -> List[str]:
+        with self._cache_lock:
+            hit = self._cache.get(token)
+        if hit is not None:
+            return hit
+        word = list(token)
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.ranks.get(p, float("inf")))
+            if best not in self.ranks:
+                break
+            first, second = best
+            merged: List[str] = []
+            i = 0
+            while i < len(word):
+                if (
+                    i < len(word) - 1
+                    and word[i] == first
+                    and word[i + 1] == second
+                ):
+                    merged.append(first + second)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = merged
+        with self._cache_lock:
+            if len(self._cache) < 65536:  # bound drain-scale memory
+                self._cache[token] = word
+        return word
+
+    def encode(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for tok in _PAT.findall(text):
+            mapped = "".join(
+                self.byte_encoder[b] for b in tok.encode("utf-8")
+            )
+            ids.extend(self.vocab[piece] for piece in self._bpe(mapped))
+        return ids
+
+    def decode(self, ids) -> str:
+        text = "".join(self.inv_vocab.get(int(i), "") for i in ids)
+        raw = bytes(
+            self.byte_decoder[c] for c in text if c in self.byte_decoder
+        )
+        return raw.decode("utf-8", errors="replace")

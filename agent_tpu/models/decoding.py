@@ -1,0 +1,149 @@
+"""Shared autoregressive decode engines: greedy and beam scans.
+
+One ``lax.scan`` program per decode (static step count, no per-step retrace,
+KV caches threaded through the carry) — the pattern SURVEY.md §7 calls the
+hard part of decode-under-jit. The model supplies a step function and its
+caches; the engine supplies the control flow, EOS bookkeeping, and (for
+beam) the joint top-K + cache reordering. Both the in-house seq2seq family
+and the imported BART family run on these engines, so generation semantics
+can never drift between families.
+
+``step_fn(tok [B], step scalar, caches) -> (logits [B, V] f32, caches)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from agent_tpu.models.layers import NEG_INF
+
+StepFn = Callable[[jax.Array, jax.Array, Any], Tuple[jax.Array, Any]]
+
+
+def greedy_scan(
+    step_fn: StepFn,
+    caches: Any,
+    batch: int,
+    max_new_tokens: int,
+    *,
+    start_id: int,
+    eos_id: int,
+    pad_id: int = 0,
+    forced_first_id: Optional[int] = None,
+    forced_last_id: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Greedy decode → (tokens [B, T], lengths [B]).
+
+    Rows emit ``pad_id`` after their EOS; ``forced_first_id`` (e.g. BART's
+    ``forced_bos_token_id``) overrides the step-0 argmax, and
+    ``forced_last_id`` (``forced_eos_token_id``) the final step's, when set.
+    """
+    bos = jnp.full((batch,), start_id, dtype=jnp.int32)
+    done0 = jnp.zeros((batch,), dtype=jnp.bool_)
+    last = max_new_tokens - 1
+
+    def body(carry, step):
+        tok, done, caches = carry
+        logits, caches = step_fn(tok, step, caches)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if forced_first_id is not None:
+            nxt = jnp.where(step == 0, jnp.int32(forced_first_id), nxt)
+        if forced_last_id is not None:
+            nxt = jnp.where(step == last, jnp.int32(forced_last_id), nxt)
+        nxt = jnp.where(done, jnp.full_like(nxt, pad_id), nxt)
+        return (nxt, done | (nxt == eos_id), caches), nxt
+
+    (_, _, _), toks = jax.lax.scan(
+        body, (bos, done0, caches),
+        jnp.arange(max_new_tokens, dtype=jnp.int32),
+    )
+    toks = toks.T  # [B, T]
+    lengths = jnp.sum((toks != pad_id) & (toks != eos_id), axis=1)
+    return toks, lengths
+
+
+def beam_scan(
+    step_fn: StepFn,
+    caches: Any,
+    batch: int,
+    vocab_size: int,
+    max_new_tokens: int,
+    *,
+    num_beams: int,
+    start_id: int,
+    eos_id: int,
+    pad_id: int = 0,
+    length_penalty: float = 1.0,
+    forced_first_id: Optional[int] = None,
+    forced_last_id: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Beam-search decode → (tokens [B, T], lengths [B]); static shapes.
+
+    Beams flatten into the batch dim (the model's step executable is shared
+    with greedy at ``B*K`` rows); each step takes one top-K over the joint
+    ``[B, K*V]`` scores and gathers the KV caches along the beam axis.
+    Finished beams collapse their next-token distribution to ``pad_id`` at
+    zero cost, freezing their score. Selection normalizes by
+    ``length ** length_penalty``. ``num_beams=1`` reduces to exactly greedy.
+    """
+    B, K, V, T = batch, num_beams, vocab_size, max_new_tokens
+    tok0 = jnp.full((B * K,), start_id, dtype=jnp.int32)
+    # Step 0: all K beams are identical, so only beam 0 may survive top-K.
+    scores0 = jnp.tile(
+        jnp.array([0.0] + [NEG_INF] * (K - 1), dtype=jnp.float32), (B, 1)
+    )
+    done0 = jnp.zeros((B, K), dtype=jnp.bool_)
+    toks0 = jnp.zeros((B, K, T), dtype=jnp.int32)
+    pad_only = jnp.full((V,), NEG_INF, dtype=jnp.float32).at[pad_id].set(0.0)
+    forced_only = (
+        jnp.full((V,), NEG_INF, dtype=jnp.float32).at[forced_first_id].set(0.0)
+        if forced_first_id is not None
+        else None
+    )
+    forced_last = (
+        jnp.full((V,), NEG_INF, dtype=jnp.float32).at[forced_last_id].set(0.0)
+        if forced_last_id is not None
+        else None
+    )
+
+    def body(carry, step):
+        tok, scores, done, toks, caches = carry
+        logits, caches = step_fn(tok, step, caches)   # [B*K, V]
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
+        if forced_only is not None:
+            logp = jnp.where(step == 0, forced_only[None, None, :], logp)
+        if forced_last is not None:
+            logp = jnp.where(step == T - 1, forced_last[None, None, :], logp)
+        logp = jnp.where(done[:, :, None], pad_only[None, None, :], logp)
+        flat = (scores[:, :, None] + logp).reshape(B, K * V)
+        new_scores, idx = jax.lax.top_k(flat, K)      # [B, K]
+        beam_idx = idx // V                           # [B, K] parent beam
+        new_tok = (idx % V).astype(jnp.int32)
+
+        toks = jnp.take_along_axis(toks, beam_idx[:, :, None], axis=1)
+        toks = jax.lax.dynamic_update_slice(
+            toks, new_tok[:, :, None], (0, 0, step)
+        )
+        done = jnp.take_along_axis(done, beam_idx, axis=1) | (new_tok == eos_id)
+
+        def reorder(c):
+            x = c.reshape(B, K, *c.shape[1:])
+            ix = beam_idx.reshape(B, K, *([1] * (c.ndim - 1)))
+            return jnp.take_along_axis(x, ix, axis=1).reshape(c.shape)
+
+        caches = jax.tree_util.tree_map(reorder, caches)
+        return (new_tok.reshape(B * K), new_scores, done, toks, caches), None
+
+    (_, scores, _, toks, _), _ = jax.lax.scan(
+        body, (tok0, scores0, done0, toks0, caches),
+        jnp.arange(T, dtype=jnp.int32),
+    )
+    lengths = jnp.sum((toks != pad_id) & (toks != eos_id), axis=2)  # [B, K]
+    norm = scores / jnp.maximum(lengths, 1).astype(jnp.float32) ** length_penalty
+    best = jnp.argmax(norm, axis=1)
+    out = jnp.take_along_axis(toks, best[:, None, None], axis=1)[:, 0]
+    out_len = jnp.take_along_axis(lengths, best[:, None], axis=1)[:, 0]
+    return out, out_len

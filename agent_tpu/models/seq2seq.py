@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from agent_tpu.models import layers
-from agent_tpu.models.layers import NEG_INF, Params
+from agent_tpu.models.layers import Params
 from agent_tpu.models.tokenizer import BOS_ID, EOS_ID, PAD_ID
 
 
@@ -146,28 +146,18 @@ def greedy_generate(
     ring/sp path, SURVEY.md §5.7); decode steps query one position against the
     KV cache, where sequence sharding buys nothing.
     """
+    from agent_tpu.models.decoding import greedy_scan
+
     B = src_ids.shape[0]
     enc_out = encode(params, src_ids, src_mask, cfg, attn_fn=attn_fn)
-    caches = _empty_cache(cfg, B)
-    bos = jnp.full((B,), BOS_ID, dtype=jnp.int32)
-    done0 = jnp.zeros((B,), dtype=jnp.bool_)
 
-    def step_fn(carry, step):
-        tok, done, caches = carry
-        logits, caches = _decode_step(
-            params, tok, step, enc_out, src_mask, caches, cfg
-        )
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        nxt = jnp.where(done, jnp.zeros_like(nxt), nxt)  # PAD after EOS
-        new_done = done | (nxt == EOS_ID)
-        return (nxt, new_done, caches), nxt
+    def step_fn(tok, step, caches):
+        return _decode_step(params, tok, step, enc_out, src_mask, caches, cfg)
 
-    (_, done, _), toks = jax.lax.scan(
-        step_fn, (bos, done0, caches), jnp.arange(max_new_tokens, dtype=jnp.int32)
+    return greedy_scan(
+        step_fn, _empty_cache(cfg, B), B, max_new_tokens,
+        start_id=BOS_ID, eos_id=EOS_ID, pad_id=PAD_ID,
     )
-    toks = toks.T  # [B, T]
-    lengths = jnp.sum((toks != 0) & (toks != EOS_ID), axis=1)
-    return toks, lengths
 
 
 def beam_generate(
@@ -196,64 +186,21 @@ def beam_generate(
     Returns (tokens [B, max_new_tokens], lengths [B]) like
     :func:`greedy_generate` (``num_beams=1`` reduces to exactly greedy).
     """
-    B = src_ids.shape[0]
-    K = num_beams
-    V = cfg.vocab_size
-    T = max_new_tokens
+    from agent_tpu.models.decoding import beam_scan
 
+    B, K = src_ids.shape[0], num_beams
     enc_out = encode(params, src_ids, src_mask, cfg, attn_fn=attn_fn)
     enc_out = jnp.repeat(enc_out, K, axis=0)            # [B*K, Ls, d]
     enc_mask = jnp.repeat(src_mask, K, axis=0)          # [B*K, Ls]
-    caches = _empty_cache(cfg, B * K)
 
-    tok0 = jnp.full((B * K,), BOS_ID, dtype=jnp.int32)
-    # Step 0: all K beams are identical, so only beam 0 may survive top-K.
-    scores0 = jnp.tile(
-        jnp.array([0.0] + [NEG_INF] * (K - 1), dtype=jnp.float32), (B, 1)
-    )                                                    # [B, K]
-    done0 = jnp.zeros((B, K), dtype=jnp.bool_)
-    toks0 = jnp.zeros((B, K, T), dtype=jnp.int32)
+    def step_fn(tok, step, caches):
+        return _decode_step(params, tok, step, enc_out, enc_mask, caches, cfg)
 
-    pad_only = jnp.full((V,), NEG_INF, dtype=jnp.float32).at[PAD_ID].set(0.0)
-
-    def step_fn(carry, step):
-        tok, scores, done, toks, caches = carry
-        logits, caches = _decode_step(
-            params, tok, step, enc_out, enc_mask, caches, cfg
-        )                                                # [B*K, V]
-        logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
-        logp = jnp.where(done[:, :, None], pad_only[None, None, :], logp)
-        flat = (scores[:, :, None] + logp).reshape(B, K * V)
-        new_scores, idx = jax.lax.top_k(flat, K)         # [B, K]
-        beam_idx = idx // V                              # [B, K] parent beam
-        new_tok = (idx % V).astype(jnp.int32)
-
-        toks = jnp.take_along_axis(toks, beam_idx[:, :, None], axis=1)
-        toks = jax.lax.dynamic_update_slice(
-            toks, new_tok[:, :, None], (0, 0, step)
-        )
-        done = jnp.take_along_axis(done, beam_idx, axis=1) | (new_tok == EOS_ID)
-
-        def reorder(c):
-            x = c.reshape(B, K, *c.shape[1:])
-            ix = beam_idx.reshape(B, K, *([1] * (c.ndim - 1)))
-            return jnp.take_along_axis(x, ix, axis=1).reshape(c.shape)
-
-        caches = jax.tree_util.tree_map(reorder, caches)
-        return (new_tok.reshape(B * K), new_scores, done, toks, caches), None
-
-    (_, scores, _, toks, _), _ = jax.lax.scan(
-        step_fn,
-        (tok0, scores0, done0, toks0, caches),
-        jnp.arange(T, dtype=jnp.int32),
+    return beam_scan(
+        step_fn, _empty_cache(cfg, B * K), B, cfg.vocab_size, max_new_tokens,
+        num_beams=K, start_id=BOS_ID, eos_id=EOS_ID, pad_id=PAD_ID,
+        length_penalty=length_penalty,
     )
-
-    lengths = jnp.sum((toks != PAD_ID) & (toks != EOS_ID), axis=2)  # [B, K]
-    norm = scores / jnp.maximum(lengths, 1).astype(jnp.float32) ** length_penalty
-    best = jnp.argmax(norm, axis=1)                       # [B]
-    out = jnp.take_along_axis(toks, best[:, None, None], axis=1)[:, 0]
-    out_len = jnp.take_along_axis(lengths, best[:, None], axis=1)[:, 0]
-    return out, out_len
 
 
 def load_npz(path: str, cfg: Seq2SeqConfig) -> Params:

@@ -58,7 +58,55 @@ def _get_cfg(payload: Dict[str, Any]):
     return config_from_payload(payload, Seq2SeqConfig)
 
 
-def _build_params(model_id: str, cfg):
+def _resolve_family(model_id: str) -> str:
+    """``model_path`` pointing at a local HF BART checkpoint directory serves
+    the pretrained family — the reference's actual summarize model
+    (ref ``ops/map_summarize.py:29-32``); else the in-house seq2seq.
+
+    Any OTHER checkpoint directory (an HF dir whose model_type isn't bart)
+    fails the shard loudly: silently serving seeded random weights for what
+    was unambiguously a checkpoint would return ok=true nonsense."""
+    from agent_tpu.models import bart, bert
+
+    if bart.is_hf_bart_dir(model_id):
+        return "bart"
+    if bert.is_hf_dir(model_id):  # generic "HF checkpoint dir" detector
+        raise RuntimeError(
+            f"model_path {model_id!r} is a checkpoint directory but not a "
+            "BART one (map_summarize serves model_type=bart; classify "
+            "serves BERT)"
+        )
+    return "seq2seq"
+
+
+# model_config fields a payload may override for a checkpoint model:
+# serving controls only (structural fields are the checkpoint's).
+_BART_SERVING_OVERRIDES = ("dtype",)
+
+
+def _get_bart_cfg(model_id: str, payload: Dict[str, Any]):
+    import os as _os
+
+    from agent_tpu.models.bart import BartConfig
+
+    overrides = payload.get("model_config")
+    allowed = {}
+    if isinstance(overrides, dict):
+        allowed = {
+            k: v for k, v in overrides.items()
+            if k in _BART_SERVING_OVERRIDES
+        }
+    return BartConfig.from_hf_json(
+        _os.path.join(model_id, "config.json"), **allowed
+    )
+
+
+def _build_params(model_id: str, cfg, family: str = "seq2seq"):
+    if family == "bart":
+        from agent_tpu.models import bart
+
+        _, params = bart.load_hf_dir(model_id, dtype=cfg.dtype)
+        return params
     from agent_tpu.models import seq2seq
 
     if model_id.endswith(".npz") and os.path.exists(model_id):
@@ -69,19 +117,32 @@ def _build_params(model_id: str, cfg):
 MAX_BATCH = 1024
 
 
-def _stage_chunks(dp: int, texts: List[str], cfg) -> List:
-    """Shared fused tokenize+pad (``_model_common.stage_text_chunks``),
-    BOS/EOS added for the seq2seq encoder."""
+def _stage_chunks(dp: int, texts: List[str], cfg,
+                  family: str = "seq2seq", model_id: str = "") -> List:
+    """Shared staging scaffolding (``_model_common.stage_text_chunks``):
+    fused byte tokenize+pad with BOS/EOS for the in-house seq2seq, the
+    checkpoint's byte-level BPE (``<s> … </s>``) for the BART family."""
     from agent_tpu.ops._model_common import stage_text_chunks
+
+    encode_pad = None
+    if family == "bart":
+        from agent_tpu.models import bart
+
+        tok = bart.hf_bpe(model_id)
+
+        def encode_pad(chunk, lb, bb):
+            return bart.encode_pad_batch(tok, chunk, cfg, bb, lb)
 
     return stage_text_chunks(
         dp, texts, max_len=cfg.max_src_len, vocab_size=cfg.vocab_size,
         max_batch=MAX_BATCH, add_bos=True, add_eos=True,
+        encode_pad=encode_pad,
     )
 
 
 def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
-                   max_new: int, num_beams: int) -> List[np.ndarray]:
+                   max_new: int, num_beams: int,
+                   family: str = "seq2seq") -> List[np.ndarray]:
     """Device phase: decode staged chunks → per-chunk token arrays [n, T].
 
     Chunks dispatch asynchronously and are fetched after the loop, so host
@@ -92,13 +153,20 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
 
     from agent_tpu.models import seq2seq
     from agent_tpu.ops._model_common import cfg_key
-    from agent_tpu.parallel.shardings import seq2seq_param_specs
+    from agent_tpu.parallel.shardings import (
+        bart_param_specs,
+        seq2seq_param_specs,
+    )
 
+    specs = (
+        bart_param_specs(cfg) if family == "bart"
+        else seq2seq_param_specs(cfg)
+    )
     # tp>1 mesh → weights land sharded, same serving-path TP as classify.
     params = runtime.get_params(
-        f"{model_id}#seq2seq#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}",
-        lambda: _build_params(model_id, cfg),
-        specs=seq2seq_param_specs(cfg),
+        f"{model_id}#{family}#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}",
+        lambda: _build_params(model_id, cfg, family),
+        specs=specs,
     )
     attn_fn = runtime.attention_fn()  # ring over sp for the encoder pass
     pending = []
@@ -111,14 +179,22 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
         def build(Ls=Ls):
             import jax.numpy as jnp
 
-            gen = (
-                (lambda p, i, m: seq2seq.greedy_generate(
-                    p, i, m, cfg, max_new, attn_fn=attn_fn))
-                if num_beams <= 1
-                else (lambda p, i, m: seq2seq.beam_generate(
+            if family == "bart":
+                from agent_tpu.models import bart
+
+                gen = lambda p, i, m: bart.generate(  # noqa: E731
                     p, i, m, cfg, max_new, num_beams=num_beams,
-                    attn_fn=attn_fn))
-            )
+                    attn_fn=attn_fn,
+                )
+            else:
+                gen = (
+                    (lambda p, i, m: seq2seq.greedy_generate(
+                        p, i, m, cfg, max_new, attn_fn=attn_fn))
+                    if num_beams <= 1
+                    else (lambda p, i, m: seq2seq.beam_generate(
+                        p, i, m, cfg, max_new, num_beams=num_beams,
+                        attn_fn=attn_fn))
+                )
 
             def run_gen(p, i, n):
                 mask = (jnp.arange(Ls)[None, :] < n[:, None]).astype(jnp.int32)
@@ -127,7 +203,8 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
             return jax.jit(run_gen)
 
         fn = runtime.compiled(
-            ("map_summarize", model_id, B, Ls, max_new, num_beams, cfg_key(cfg)),
+            ("map_summarize", model_id, family, B, Ls, max_new, num_beams,
+             cfg_key(cfg)),
             build,
         )
         toks, _ = fn(
@@ -198,7 +275,13 @@ def stage(payload: Any, ctx: Optional[object] = None):
         return "done", bad_input(str(exc))
 
     model_id = _resolve_model_id(payload)
-    cfg = _get_cfg(payload)
+    family = _resolve_family(model_id)
+    # Checkpoint-integrity problems (unreadable config.json) raise past the
+    # soft-error handlers on purpose: retryable shard failure, not bad input.
+    cfg = (
+        _get_bart_cfg(model_id, payload) if family == "bart"
+        else _get_cfg(payload)
+    )
     max_new = min(max_new, cfg.max_tgt_len)
 
     from agent_tpu.config import OpsConfig
@@ -231,12 +314,15 @@ def stage(payload: Any, ctx: Optional[object] = None):
 
     state = {
         "t0": t0,
-        "chunks": _stage_chunks(dp, texts, cfg),
+        "chunks": _stage_chunks(
+            dp, texts, cfg, family=family, model_id=model_id
+        ),
         "empty_rows": empty_rows,
         "single": single,
         "max_new": max_new,
         "num_beams": num_beams,
         "model_id": model_id,
+        "family": family,
         "cfg": cfg,
         "force_cpu": ops_cfg.summarize_force_cpu,
         "output_dir": output_dir,
@@ -262,7 +348,7 @@ def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, An
 
     state["token_chunks"] = _decode_chunks(
         runtime, state["chunks"], state["model_id"], state["cfg"],
-        state["max_new"], state["num_beams"],
+        state["max_new"], state["num_beams"], family=state["family"],
     )
     state["device"] = runtime.platform
     state["t_device"] = time.perf_counter()
@@ -272,12 +358,31 @@ def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, An
 def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, Any]:
     """Host phase: detokenize fetched token rows, write the sink, shape the
     result. Safe off the device thread (reads numpy arrays only)."""
-    from agent_tpu.models.tokenizer import ByteTokenizer
-
-    tok = ByteTokenizer()
     summaries: List[str] = []
-    for toks in state["token_chunks"]:
-        summaries.extend(tok.decode([t for t in row if t > 0]) for row in toks)
+    if state["family"] == "bart":
+        from agent_tpu.models import bart
+
+        cfg = state["cfg"]
+        tok = bart.hf_bpe(state["model_id"])
+        # Same id set transformers' skip_special_tokens drops — including
+        # <unk> — so the served text matches the reference decode.
+        skip = {cfg.pad_id, cfg.bos_id, cfg.eos_id, cfg.decoder_start_id}
+        unk = tok.vocab.get("<unk>")
+        if unk is not None:
+            skip.add(unk)
+        for toks in state["token_chunks"]:
+            summaries.extend(
+                tok.decode([t for t in row if int(t) not in skip]).strip()
+                for row in toks
+            )
+    else:
+        from agent_tpu.models.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        for toks in state["token_chunks"]:
+            summaries.extend(
+                tok.decode([t for t in row if t > 0]) for row in toks
+            )
     for i in state["empty_rows"]:
         summaries[i] = ""  # no input → no summary, not model noise
 

@@ -122,6 +122,47 @@ def seq2seq_param_specs(cfg) -> Params:
     }
 
 
+def bart_param_specs(cfg) -> Params:
+    """PartitionSpec pytree matching ``models.bart.from_state_dict`` — the
+    same column/row pattern as :func:`bert_param_specs`, vocab-dim sharding
+    for the tied embedding/lm-head table."""
+
+    def attn():
+        return {
+            "q": _dense_specs(col=True),
+            "k": _dense_specs(col=True),
+            "v": _dense_specs(col=True),
+            "o": _dense_specs(col=False),
+        }
+
+    def blk(cross: bool):
+        p: Params = {
+            "self": attn(),
+            "ln1": _ln_specs(),
+            "fc1": _dense_specs(col=True),
+            "fc2": _dense_specs(col=False),
+            "ln2": _ln_specs(),
+        }
+        if cross:
+            p["cross"] = attn()
+            p["ln_x"] = _ln_specs()
+        return p
+
+    def branch(n: int, cross: bool):
+        return {
+            "pos": P(),
+            "ln_emb": _ln_specs(),
+            "layers": [blk(cross) for _ in range(n)],
+        }
+
+    return {
+        "embed": P("tp", None),
+        "final_logits_bias": P(),
+        "enc": branch(cfg.n_enc_layers, cross=False),
+        "dec": branch(cfg.n_dec_layers, cross=True),
+    }
+
+
 def _axes_size(mesh, entry) -> int:
     """Mesh extent of one PartitionSpec entry (name or tuple of names)."""
     if entry is None:

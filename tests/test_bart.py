@@ -1,0 +1,224 @@
+"""HF-BART family (``models/bart.py`` + ``models/bpe.py``): the imported
+checkpoint must reproduce ``transformers``' logits, generation, and
+tokenization, and serve through map_summarize from a local checkpoint
+directory — the reference's actual summarize model served TPU-side
+(reference ``ops/map_summarize.py:29-32``)."""
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+
+from agent_tpu.models import bart  # noqa: E402
+from agent_tpu.models.bpe import ByteLevelBPE, bytes_to_unicode  # noqa: E402
+
+TINY = dict(
+    d_model=32, encoder_layers=2, decoder_layers=2,
+    encoder_attention_heads=4, decoder_attention_heads=4,
+    encoder_ffn_dim=64, decoder_ffn_dim=64,
+    max_position_embeddings=64,
+    pad_token_id=1, bos_token_id=0, eos_token_id=2,
+    decoder_start_token_id=2, forced_bos_token_id=0,
+)
+
+MERGES = [("h", "e"), ("l", "l"), ("ll", "o"), ("Ġ", "w"), ("Ġw", "o")]
+
+
+def _build_vocab():
+    base = list(bytes_to_unicode().values())
+    # Specials at HF's standard ids, full byte alphabet, then the merge
+    # products (one vocab entry per MERGES pair).
+    toks = ["<s>", "<pad>", "</s>", "<unk>"] + base \
+        + ["he", "ll", "llo", "Ġw", "Ġwo"]
+    return {t: i for i, t in enumerate(toks)}
+
+
+@pytest.fixture(scope="module")
+def hf_dir(tmp_path_factory):
+    """A real on-disk HF BART checkpoint (config.json + pytorch_model.bin +
+    vocab.json + merges.txt) built offline from a seeded random model."""
+    d = tmp_path_factory.mktemp("bart_ckpt")
+    vocab = _build_vocab()
+    (d / "vocab.json").write_text(
+        __import__("json").dumps(vocab), encoding="utf-8"
+    )
+    (d / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in MERGES) + "\n",
+        encoding="utf-8",
+    )
+    torch.manual_seed(0)
+    cfg = transformers.BartConfig(vocab_size=len(vocab), **TINY)
+    model = transformers.BartForConditionalGeneration(cfg).eval()
+    model.save_pretrained(str(d), safe_serialization=False)
+    return str(d), model
+
+
+@pytest.fixture(scope="module")
+def hf_tok(hf_dir):
+    path, _ = hf_dir
+    return transformers.BartTokenizer(
+        vocab_file=f"{path}/vocab.json", merges_file=f"{path}/merges.txt"
+    )
+
+
+def test_bpe_matches_transformers(hf_dir, hf_tok):
+    path, _ = hf_dir
+    tok = ByteLevelBPE.from_dir(path)
+    for text in [
+        "hello world", "he llo", "wo wo hello", "  spaced  out ",
+        "punct, here! (ok)", "unicode: café ≤ λ", "hello's won't",
+    ]:
+        ours = tok.encode(text)
+        theirs = hf_tok(text, add_special_tokens=False)["input_ids"]
+        assert ours == theirs, (text, ours, theirs)
+        assert tok.decode(ours) == text
+
+
+def test_forward_matches_transformers(hf_dir):
+    path, torch_model = hf_dir
+    cfg, params = bart.load_hf_dir(path, dtype="float32")
+    assert cfg.n_enc_layers == 2 and cfg.forced_bos_id == 0
+
+    rng = np.random.default_rng(0)
+    src = rng.integers(4, cfg.vocab_size, (3, 9)).astype(np.int32)
+    src_mask = np.ones((3, 9), dtype=np.int32)
+    src_mask[1, 6:] = 0
+    src[1, 6:] = cfg.pad_id
+    tgt = rng.integers(4, cfg.vocab_size, (3, 5)).astype(np.int32)
+    tgt[:, 0] = cfg.decoder_start_id
+
+    with torch.no_grad():
+        want = torch_model(
+            input_ids=torch.tensor(src, dtype=torch.long),
+            attention_mask=torch.tensor(src_mask, dtype=torch.long),
+            decoder_input_ids=torch.tensor(tgt, dtype=torch.long),
+        ).logits.numpy()
+    enc = bart.encode(params, src, src_mask, cfg)
+    got = np.asarray(
+        jax.jit(
+            lambda p, t, e, m: bart.decode_full(p, t, e, m, cfg)
+        )(params, tgt, enc, src_mask)
+    )
+    np.testing.assert_allclose(got, want, atol=3e-4)
+
+
+def test_greedy_generation_matches_transformers(hf_dir):
+    path, torch_model = hf_dir
+    cfg, params = bart.load_hf_dir(path, dtype="float32")
+    rng = np.random.default_rng(1)
+    src = rng.integers(4, cfg.vocab_size, (2, 7)).astype(np.int32)
+    mask = np.ones((2, 7), dtype=np.int32)
+    T = 8
+
+    with torch.no_grad():
+        want = torch_model.generate(
+            input_ids=torch.tensor(src, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+            max_new_tokens=T, num_beams=1, do_sample=False, min_length=0,
+        ).numpy()
+    toks, _ = jax.jit(
+        lambda p, i, m: bart.generate(p, i, m, cfg, T)
+    )(params, src, mask)
+    toks = np.asarray(toks)
+    # HF output row = [decoder_start, generated...]; ours is the generated
+    # part. Compare up to HF's produced length (HF may stop early at EOS and
+    # pad; both pad with cfg.pad_id so full-row comparison holds).
+    want_gen = want[:, 1:]
+    n = min(want_gen.shape[1], T)
+    np.testing.assert_array_equal(toks[:, :n], want_gen[:, :n])
+
+
+def test_cached_decode_equals_full_forward(hf_dir):
+    """The KV-cached step must produce the same logits path as the
+    teacher-forced full decoder (greedy tokens re-fed through decode_full
+    argmax-match at every step)."""
+    path, _ = hf_dir
+    cfg, params = bart.load_hf_dir(path, dtype="float32")
+    rng = np.random.default_rng(2)
+    src = rng.integers(4, cfg.vocab_size, (2, 6)).astype(np.int32)
+    mask = np.ones((2, 6), dtype=np.int32)
+    T = 6
+    toks, _ = bart.generate(params, src, mask, cfg, T)
+    toks = np.asarray(toks)
+    # Re-run teacher-forced with the generated prefix.
+    dec_in = np.concatenate(
+        [np.full((2, 1), cfg.decoder_start_id, dtype=np.int32), toks[:, :-1]],
+        axis=1,
+    )
+    enc = bart.encode(params, src, mask, cfg)
+    logits = np.asarray(bart.decode_full(params, dec_in, enc, mask, cfg))
+    # Wherever the row wasn't finished, the full-forward argmax must equal
+    # the emitted token (step 0 is the forced BOS, so start at 1).
+    for b in range(2):
+        for t in range(1, T):
+            if toks[b, t] in (cfg.pad_id, cfg.eos_id):
+                break
+            assert logits[b, t].argmax() == toks[b, t], (b, t)
+
+
+def test_serves_through_summarize_op(hf_dir, hf_tok):
+    from agent_tpu.ops import get_op
+    from agent_tpu.runtime.context import OpContext
+    from agent_tpu.runtime.runtime import get_runtime
+
+    path, torch_model = hf_dir
+    summarize = get_op("map_summarize")
+    ctx = OpContext(runtime=get_runtime())
+    text = "hello world wo hello"
+    out = summarize(
+        {
+            "texts": [text, "he llo wo"],
+            "max_length": 6,
+            "model_path": path,
+            "model_config": {"dtype": "float32"},
+        },
+        ctx,
+    )
+    assert out["ok"] is True and out["model"] == path
+    assert len(out["summaries"]) == 2
+
+    # Cross-check row 0 against torch at the SAME padded shape the op's
+    # 16-bucket produced: this untrained random model has near-tied logits,
+    # so an argmax comparison is only meaningful when both sides see
+    # identical padding (a trained checkpoint's logits are decisive; the
+    # unpadded-vs-HF parity is covered by the direct generation test).
+    enc = hf_tok(
+        text, return_tensors="pt", padding="max_length", max_length=16
+    )
+    with torch.no_grad():
+        want_ids = torch_model.generate(
+            **enc, max_new_tokens=6, num_beams=1, do_sample=False,
+            min_length=0,
+        )[0]
+    want = hf_tok.decode(want_ids, skip_special_tokens=True).strip()
+    assert out["summaries"][0] == want
+
+
+def test_non_bart_checkpoint_dir_fails_loudly(tmp_path):
+    """A checkpoint dir of the wrong family must FAIL, not silently serve
+    seeded random weights with ok=true."""
+    from agent_tpu.ops import get_op
+    from agent_tpu.runtime.context import OpContext
+    from agent_tpu.runtime.runtime import get_runtime
+
+    d = tmp_path / "bert_dir"
+    d.mkdir()
+    (d / "config.json").write_text('{"model_type": "bert", "vocab_size": 8}')
+    with pytest.raises(RuntimeError, match="not a BART"):
+        get_op("map_summarize")(
+            {"texts": ["row text"], "model_path": str(d), "max_length": 4},
+            OpContext(runtime=get_runtime()),
+        )
+
+
+def test_beam_runs_and_returns_shapes(hf_dir):
+    path, _ = hf_dir
+    cfg, params = bart.load_hf_dir(path, dtype="float32")
+    src = np.full((2, 5), 10, dtype=np.int32)
+    mask = np.ones((2, 5), dtype=np.int32)
+    toks, lengths = bart.generate(params, src, mask, cfg, 5, num_beams=3)
+    assert np.asarray(toks).shape == (2, 5)
+    assert np.asarray(lengths).shape == (2,)

@@ -76,6 +76,38 @@ def test_missing_head_gets_deterministic_init(hf_dir):
                               np.asarray(c["head"]["w"]))
 
 
+def test_basic_normalize_matches_transformers():
+    """Accent stripping + CJK spacing must match HF BasicTokenizer so
+    'café' finds 'cafe' in the vocab instead of encoding as [UNK]."""
+    from transformers.models.bert.tokenization_bert import BasicTokenizer
+
+    basic = BasicTokenizer(do_lower_case=True)
+    from agent_tpu.models.tokenizer import WordPieceTokenizer
+
+    for text in ["Café résumé", "naïve Über",
+                 "mixed 中文 text", "已只 ascii"]:
+        want = basic.tokenize(text)
+        tok = WordPieceTokenizer(
+            vocab={w: i for i, w in enumerate(
+                ["[PAD]", "[UNK]", "[CLS]", "[SEP]"] + want
+            )},
+            lowercase=True,
+        )
+        norm = bert.basic_normalize(text, strip_accents=True)
+        ids = tok.encode(norm)
+        inv = {i: w for w, i in tok.vocab.items()}
+        got = [inv[i] for i in ids]
+        assert got == want, (text, got, want)
+
+
+def test_non_bert_checkpoint_dir_fails_loudly(tmp_path):
+    d = tmp_path / "bart_dir"
+    d.mkdir()
+    (d / "config.json").write_text('{"model_type": "bart", "vocab_size": 8}')
+    with pytest.raises(RuntimeError, match="not a BERT checkpoint"):
+        bert.BertConfig.from_hf_json(str(d / "config.json"))
+
+
 def test_wordpiece_encode_pad(hf_dir):
     path, _ = hf_dir
     tok = bert.hf_wordpiece(path)
