@@ -35,14 +35,16 @@ from agent_tpu.models.layers import NEG_INF, dot_product_attention
 
 _LANES = 128  # VPU lane width; scratch last dims pad to this anyway
 
-# Below this key length the XLA dense path wins: its batched-matmul schedule
-# beats the kernel's per-(b,h) grid when the score matrix is small. The
-# kernel's advantage is not materializing [Lq, Lk] scores in HBM, which only
-# matters once that matrix is big. Measured on v5e (RTT-amortized, d_head
-# 128): flash 3.7× at Lk=4k, >50× at 8k where the dense path's score
-# materialization thrashes HBM (450 ms/call vs 8.5 ms). With d_head ≤ 64 the
-# kernel's MXU contraction is underfilled (ratio 1.3–1.8×) — long-context
-# model configs here keep d_head at the 128 MXU tile (see bench.py).
+# Below this key length the XLA dense path wins END TO END. Attention-only
+# microbenchmarks on v5e show the kernel ahead already at Lk=512/d_head 64
+# (1.25-1.4×), but inside the full encoder the gate at 512 measured ~13%
+# SLOWER at BERT-base scale (804 vs 929 rows/s): pallas_call is a fusion
+# barrier — XLA can no longer fuse the projection matmuls/softmax chain
+# around attention — and the [B,L,H,D]→grid layout transitions eat the
+# kernel's margin. The win is real only once the dense path's [Lq, Lk]
+# score materialization dominates: 3.7× at 4k/d_head 128, >50× at 8k where
+# dense thrashes HBM (450 ms/call vs 8.5 ms). Hence the 2048 gate; trust
+# model-level numbers over kernel microbenchmarks when moving it.
 FLASH_MIN_KEY_LEN = 2048
 
 # Trace-time selection tally: ``flash_attention`` decides kernel-vs-dense while
