@@ -78,14 +78,28 @@ def forward(
     mask: jax.Array,     # [B, L] int32 padding mask (1 = real)
     cfg: EncoderConfig,
     attn_fn=layers.dot_product_attention,
+    remat: bool = False,
 ) -> jax.Array:
-    """Logits [B, n_classes] (f32). Mean-pool over real tokens, linear head."""
+    """Logits [B, n_classes] (f32). Mean-pool over real tokens, linear head.
+
+    ``remat=True`` wraps each block in ``jax.checkpoint`` so the backward
+    pass recomputes block activations instead of storing them — at training
+    scale the stored [B, H, L, L] attention scores otherwise exceed HBM
+    (BERT-base, batch 256, seq 512: ~39 GB saved for ~33% more FLOPs).
+    """
     dtype = cfg.compute_dtype
     L = ids.shape[1]
     x = params["embed"].astype(dtype)[ids] + params["pos"][:L].astype(dtype)[None]
     attn_mask = layers.pad_mask_to_attn(mask)
+    block_fn = (
+        jax.checkpoint(
+            lambda p, h, m: layers.encoder_block(p, h, m, dtype, attn_fn=attn_fn)
+        )
+        if remat
+        else (lambda p, h, m: layers.encoder_block(p, h, m, dtype, attn_fn=attn_fn))
+    )
     for block in params["blocks"]:
-        x = layers.encoder_block(block, x, attn_mask, dtype, attn_fn=attn_fn)
+        x = block_fn(block, x, attn_mask)
     x = layers.layer_norm(params["ln_f"], x)
     denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1).astype(jnp.float32)
     pooled = (x.astype(jnp.float32) * mask[:, :, None]).sum(axis=1) / denom

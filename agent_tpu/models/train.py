@@ -14,6 +14,7 @@ matmuls and the dp/sp gradient all-reduces; no hand-written collectives.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Tuple
 
 import jax
@@ -25,30 +26,43 @@ from agent_tpu.parallel import shardings
 
 
 def cross_entropy_loss(
-    params, ids: jax.Array, mask: jax.Array, labels: jax.Array, cfg
+    params, ids: jax.Array, mask: jax.Array, labels: jax.Array, cfg,
+    remat: bool = False,
 ) -> jax.Array:
-    logits = encoder.forward(params, ids, mask, cfg)
+    logits = encoder.forward(params, ids, mask, cfg, remat=remat)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
     return nll.mean()
 
 
-def make_train_step(cfg, optimizer=None):
+def make_train_step(cfg, optimizer=None, remat: bool = False):
     """Build ``(init_state, step)`` where ``step`` is one jitted SGD update.
 
     ``init_state(params)`` → opt_state; ``step(params, opt_state, ids, mask,
-    labels)`` → (params, opt_state, loss). Both are pure; shard placement is
-    the caller's (see :func:`place_replicated` / ``TrainHarness``).
+    labels)`` → (params, opt_state, loss). Shard placement is the caller's.
+
+    **Contract: ``step`` DONATES its (params, opt_state) arguments** — the
+    input buffers are invalidated and must be replaced with the returned
+    pair (every in-repo caller reassigns). Reusing the old pytrees after a
+    step raises "Array has been deleted"; pass explicit copies if you need
+    to step the same params twice.
+
+    ``remat=True`` rematerializes each encoder block in the backward pass
+    (``jax.checkpoint``) — required at BERT-base scale, where stored
+    attention scores alone exceed one chip's HBM (see ``encoder.forward``).
     """
     optimizer = optimizer or optax.adamw(1e-3)
 
     def init_state(params):
         return optimizer.init(params)
 
-    @jax.jit
+    # Donation: the caller always replaces (params, opt_state) with the
+    # returned pair, so XLA may update weights in place — without it the
+    # step holds two copies of params + optimizer state in HBM.
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, ids, mask, labels):
         loss, grads = jax.value_and_grad(cross_entropy_loss)(
-            params, ids, mask, labels, cfg
+            params, ids, mask, labels, cfg, remat
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

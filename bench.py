@@ -63,6 +63,8 @@ LONG_CTX_ITERS = 5
 LONG_CTX_CONFIG = {"d_model": 512, "n_heads": 4, "max_len": 4096}
 SUMMARIZE_BATCH = 256
 SUMMARIZE_MAX_NEW = 32
+TRAIN_BATCH = 256
+TRAIN_STEPS = 8
 DRAIN_ROWS = 65_536
 DRAIN_SHARD_SIZE = 8192
 DRAIN_SUMMARIZE_ROWS = 2048
@@ -277,6 +279,81 @@ def _flash_vs_dense(runtime, batch: int = 4, seq: int = 4096):
     return per_call(dot_product_attention) / per_call(flash)
 
 
+def _bench_train(runtime):
+    """Training throughput at BERT-base scale: one jitted fwd+bwd+adamw step
+    (models/train.py), examples/sec and training MFU (flops ≈ 3× forward).
+
+    Steps chain on device (step i+1 consumes step i's params), so timing N
+    dispatches and syncing once amortizes the host round trip the same way
+    the flash ratio measurement does."""
+    import jax
+    import numpy as np
+
+    from agent_tpu.models import encoder
+    from agent_tpu.models.encoder import EncoderConfig
+    from agent_tpu.models.train import make_train_step
+
+    smoke = runtime.platform != "tpu"
+    cfg = EncoderConfig(
+        **(BERT_CONFIG if not smoke
+           else {"d_model": 64, "n_heads": 4, "n_layers": 2, "d_ff": 128,
+                 "max_len": 64})
+    )
+    batch = 32 if smoke else TRAIN_BATCH
+    seq = 64 if smoke else 512
+    steps = 2 if smoke else TRAIN_STEPS
+
+    params = jax.device_put(
+        encoder.init_params(cfg, model_id="bench-train"), runtime.replicated()
+    )
+    # remat: stored [B, H, L, L] attention scores for backward would need
+    # ~39 GB at this scale; recompute them instead (flops ratio below
+    # already accounts for the fwd+bwd cost, remat's extra fwd is ~free on
+    # the MFU denominator side — we report achieved/peak of the 3x model).
+    init_state, step = make_train_step(cfg, remat=not smoke)
+    opt_state = init_state(params)
+    rng = np.random.default_rng(0)
+    ids = runtime.put_batch(
+        rng.integers(4, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    )
+    mask = runtime.put_batch(np.ones((batch, seq), dtype=np.int32))
+    labels = runtime.put_batch(
+        rng.integers(0, cfg.n_classes, (batch,)).astype(np.int32)
+    )
+
+    # TWO warmup steps: the first compiles for the init-state avals, the
+    # second for the steady-state ones (the returned opt_state's weak-typed
+    # scalars become strong, which retriggers compilation exactly once).
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, ids, mask, labels)
+        float(loss)
+
+    def window():
+        nonlocal params, opt_state
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, ids, mask, labels)
+        final = float(loss)  # one sync for the chained steps
+        wall = time.perf_counter() - t0
+        assert final == final, "train loss is NaN"
+        return batch * steps / wall, wall * 1000.0 / steps
+
+    ex_per_sec, step_ms, spread = _median_windows(window, WINDOWS)
+    flops_ex = 3 * encoder_flops_per_row(cfg, seq)  # fwd + ~2× for bwd
+    achieved = ex_per_sec * flops_ex / runtime.n_devices
+    peak = _peak_flops(runtime)
+    return {
+        "examples_per_sec": round(ex_per_sec, 1),
+        "step_ms": round(step_ms, 2),
+        "spread_pct": round(spread, 2),
+        "batch": batch,
+        "seq_len": seq,
+        "gflops_per_example": round(flops_ex / 1e9, 2),
+        "achieved_tflops": round(achieved / 1e12, 2),
+        "mfu": round(achieved / peak, 4) if peak else None,
+    }
+
+
 def _bench_summarize(runtime, batch: int = SUMMARIZE_BATCH,
                      max_new: int = SUMMARIZE_MAX_NEW):
     from agent_tpu.ops import get_op
@@ -486,6 +563,7 @@ def main() -> int:
     for name, fn in (
         ("bert_base", lambda: _bench_bert_base(runtime)),
         ("long_ctx", lambda: _bench_long_ctx(runtime)),
+        ("train", lambda: _bench_train(runtime)),
         ("summarize", lambda: _bench_summarize(runtime)),
     ):
         try:
@@ -527,6 +605,8 @@ def main() -> int:
                     "long_ctx_batch": LONG_CTX_BATCH,
                     "summarize_batch": SUMMARIZE_BATCH,
                     "summarize_max_new": SUMMARIZE_MAX_NEW,
+                    "train_batch": TRAIN_BATCH,
+                    "train_steps": TRAIN_STEPS,
                     "drain_rows": DRAIN_ROWS,
                     "drain_shard_size": DRAIN_SHARD_SIZE,
                     "drain_summarize_rows": DRAIN_SUMMARIZE_ROWS,
@@ -546,6 +626,8 @@ def main() -> int:
                 "bert_base_rows_per_sec": legs["bert_base"].get("rows_per_sec"),
                 "bert_base_mfu": legs["bert_base"].get("mfu"),
                 "long_ctx_rows_per_sec": legs["long_ctx"].get("rows_per_sec"),
+                "train_examples_per_sec": legs["train"].get("examples_per_sec"),
+                "train_mfu": legs["train"].get("mfu"),
                 "summarize_decode_tok_per_sec": legs["summarize"].get(
                     "decode_tok_per_sec"
                 ),

@@ -229,3 +229,37 @@ def test_lifecycle_through_the_swarm(ctx, tmp_path):
     pred = [row[0] for row in served["result"]["indices"]]
     acc = float(np.mean([p == l for p, l in zip(pred, eval_labels)]))
     assert acc > 0.9, f"swarm-served accuracy {acc}"
+
+
+def test_remat_forward_and_step_match_plain():
+    """remat=True is a pure memory/compute trade: forward logits and one
+    training step's loss must equal the plain path."""
+    import jax
+    import numpy as np
+
+    from agent_tpu.models import encoder
+    from agent_tpu.models.encoder import EncoderConfig
+    from agent_tpu.models.train import make_train_step
+
+    cfg = EncoderConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64, max_len=16, n_classes=8, dtype="float32")
+    params = encoder.init_params(cfg, model_id="remat-test")
+    rng = np.random.default_rng(0)
+    ids = rng.integers(4, 64, (4, 16)).astype(np.int32)
+    mask = np.ones((4, 16), dtype=np.int32)
+    labels = rng.integers(0, 8, (4,)).astype(np.int32)
+
+    a = np.asarray(encoder.forward(params, ids, mask, cfg))
+    b = np.asarray(encoder.forward(params, ids, mask, cfg, remat=True))
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+    losses = []
+    for remat in (False, True):
+        # Real copies: the step donates its (params, opt_state) arguments.
+        p = jax.tree_util.tree_map(
+            lambda x: jax.numpy.array(x, copy=True), params
+        )
+        init_state, step = make_train_step(cfg, remat=remat)
+        _, _, loss = step(p, init_state(p), ids, mask, labels)
+        losses.append(float(loss))
+    assert abs(losses[0] - losses[1]) < 1e-6, losses
