@@ -18,9 +18,8 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -449,25 +448,15 @@ def load_hf_dir(path: str, **config_overrides) -> Tuple[BartConfig, Params]:
 
 # ---- tokenizer ----
 
-_tok_cache: Dict[str, Any] = {}
-_tok_lock = threading.Lock()
-
 
 def hf_bpe(path: str):
-    """The checkpoint's byte-level BPE tokenizer (vocab.json + merges.txt),
-    cached per directory."""
-    with _tok_lock:
-        tok = _tok_cache.get(path)
-        if tok is not None:
-            return tok
+    """The checkpoint's byte-level BPE tokenizer (vocab.json + merges.txt);
+    ``ByteLevelBPE.from_dir`` caches per directory."""
     from agent_tpu.models.bpe import ByteLevelBPE
 
     if not os.path.exists(os.path.join(path, "vocab.json")):
         raise ValueError(f"BART checkpoint {path} has no vocab.json")
-    tok = ByteLevelBPE.from_dir(path)
-    with _tok_lock:
-        _tok_cache[path] = tok
-    return tok
+    return ByteLevelBPE.from_dir(path)
 
 
 def encode_pad_batch(

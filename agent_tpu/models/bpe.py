@@ -23,6 +23,9 @@ _PAT = re.compile(
     r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
 )
 
+_dir_cache: Dict[str, "ByteLevelBPE"] = {}
+_dir_cache_lock = threading.Lock()
+
 
 @lru_cache(maxsize=1)
 def bytes_to_unicode() -> Dict[int, str]:
@@ -55,8 +58,21 @@ class ByteLevelBPE:
         self._cache: Dict[str, List[str]] = {}
         self._cache_lock = threading.Lock()
 
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
     @classmethod
     def from_dir(cls, path: str) -> "ByteLevelBPE":
+        """Load (and cache) the tokenizer for a vocab directory. Cached per
+        absolute path: real vocabs are ~50k entries and the BPE merge cache
+        only pays off if callers share one instance (both ``map_tokenize``
+        and the BART serving path load through here)."""
+        key = os.path.abspath(path)
+        with _dir_cache_lock:
+            hit = _dir_cache.get(key)
+        if hit is not None:
+            return hit
         with open(os.path.join(path, "vocab.json"), encoding="utf-8") as f:
             vocab = json.load(f)
         merges: List[Tuple[str, str]] = []
@@ -67,7 +83,10 @@ class ByteLevelBPE:
                     continue
                 a, _, b = line.partition(" ")
                 merges.append((a, b))
-        return cls(vocab, merges)
+        tok = cls(vocab, merges)
+        with _dir_cache_lock:
+            _dir_cache[key] = tok
+        return tok
 
     def _bpe(self, token: str) -> List[str]:
         with self._cache_lock:

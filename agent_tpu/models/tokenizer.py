@@ -268,11 +268,21 @@ def pad_batch(
 
 
 def get_tokenizer(kind: str = "byte", vocab_path: Optional[str] = None):
-    """Factory used by ops: ``byte`` (default) or ``wordpiece`` (needs vocab)."""
+    """Factory used by ops: ``byte`` (default), ``wordpiece`` (needs a
+    vocab.txt path), or ``bpe`` (GPT-2/BART byte-level BPE; needs a
+    directory holding vocab.json + merges.txt, e.g. an HF checkpoint dir)."""
     if kind == "byte":
         return ByteTokenizer()
     if kind == "wordpiece":
         if vocab_path:
             return WordPieceTokenizer.from_file(vocab_path)
         raise ValueError("wordpiece tokenizer requires vocab_path")
+    if kind == "bpe":
+        if vocab_path:
+            from agent_tpu.models.bpe import ByteLevelBPE
+
+            return ByteLevelBPE.from_dir(vocab_path)
+        raise ValueError(
+            "bpe tokenizer requires vocab_path (dir with vocab.json + merges.txt)"
+        )
     raise ValueError(f"unknown tokenizer kind {kind!r}")

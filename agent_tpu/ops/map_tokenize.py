@@ -10,12 +10,15 @@ Capability parity with reference ``ops/map_tokenize.py:12-61``:
 - Validation errors come back as ``{"ok": False, "error": ...}`` (ref ``:25-32``).
 
 The upgrade (BASELINE.json: "map_tokenize ... HF tokenizer", made egress-free):
-``mode: "tokens"`` (the default) runs a real tokenizer (byte-level by default,
-wordpiece with a local vocab via ``tokenizer``/``vocab_path``), chunking the
-*token* stream into windows of ``chunk_size`` ids (default 1024). The whole
-items list is tokenized as one batch on the host — tokenization is host work by
-design; the device pipeline consumes its padded output (see
-``agent_tpu.models.tokenizer.pad_batch``).
+``mode: "tokens"`` (the default) runs a real tokenizer — byte-level by
+default, ``tokenizer: "wordpiece"`` with a local vocab.txt, or
+``tokenizer: "bpe"`` with a local GPT-2/BART vocab directory
+(``vocab_path`` = dir holding vocab.json + merges.txt, e.g. an HF checkpoint
+dir; ids match ``transformers``' tokenizer exactly, see ``models/bpe.py``) —
+chunking the *token* stream into windows of ``chunk_size`` ids (default
+1024). The whole items list is tokenized as one batch on the host —
+tokenization is host work by design; the device pipeline consumes its padded
+output (see ``agent_tpu.models.tokenizer.pad_batch``).
 """
 
 from __future__ import annotations
@@ -91,7 +94,14 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     except (ValueError, OSError) as exc:
         return bad_input(str(exc))
 
-    encoded = [tok.encode(t) for t in items]
+    try:
+        encoded = [tok.encode(t) for t in items]
+    except KeyError as exc:
+        # An inconsistent vocab/merges pair (merge product or base symbol
+        # missing from vocab.json) is caller input, not a crash: soft error
+        # per the op contract.
+        return bad_input(f"vocab is missing token {exc} (inconsistent "
+                         "vocab.json/merges.txt?)")
     per_item = [_chunks(ids, chunk_size) for ids in encoded]
     flat = [c for cs in per_item for c in cs]
     out = {

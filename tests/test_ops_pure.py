@@ -204,3 +204,34 @@ class TestRiskAccumulateMapReduce:
         # Malformed partials → soft error.
         bad = run({"partials": [{"count": "x"}]})
         assert bad["ok"] is False
+
+
+def test_map_tokenize_bpe_mode(tmp_path):
+    """tokenizer: 'bpe' with a local vocab dir — ids match the BPE module
+    (which is differential-tested against transformers in test_bart.py)."""
+    import json
+
+    from agent_tpu.models.bpe import ByteLevelBPE, bytes_to_unicode
+
+    base = list(bytes_to_unicode().values())
+    vocab = {t: i for i, t in enumerate(
+        ["<s>", "<pad>", "</s>", "<unk>"] + base + ["he", "ll", "llo"]
+    )}
+    (tmp_path / "vocab.json").write_text(json.dumps(vocab))
+    (tmp_path / "merges.txt").write_text("#version: 0.2\nh e\nl l\nll o\n")
+
+    out = tokenize({
+        "items": ["hello world", "he"],
+        "tokenizer": "bpe",
+        "vocab_path": str(tmp_path),
+        "chunk_size": 4,
+    })
+    assert out["ok"] is True and out["tokenizer"] == "bpe"
+    assert out["vocab_size"] == len(vocab)
+    ref = ByteLevelBPE.from_dir(str(tmp_path))
+    want = ref.encode("hello world")
+    assert out["chunks"][0] == want[:4]
+    assert out["token_counts"] == [len(want), len(ref.encode("he"))]
+
+    missing = tokenize({"items": ["x"], "tokenizer": "bpe"})
+    assert missing["ok"] is False and "vocab_path" in missing["error"]
