@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+from collections import OrderedDict
 from functools import lru_cache
 from typing import Dict, List, Tuple
 
@@ -23,7 +24,11 @@ _PAT = re.compile(
     r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
 )
 
-_dir_cache: Dict[str, "ByteLevelBPE"] = {}
+# Loaded-tokenizer cache: LRU-bounded (a drain cycling vocab_path payloads
+# must not grow host memory without bound) and keyed by file mtimes so an
+# edited vocab/merges pair reloads instead of serving stale.
+_DIR_CACHE_MAX = 8
+_dir_cache: "OrderedDict[tuple, ByteLevelBPE]" = OrderedDict()
 _dir_cache_lock = threading.Lock()
 
 
@@ -65,18 +70,36 @@ class ByteLevelBPE:
     @classmethod
     def from_dir(cls, path: str) -> "ByteLevelBPE":
         """Load (and cache) the tokenizer for a vocab directory. Cached per
-        absolute path: real vocabs are ~50k entries and the BPE merge cache
-        only pays off if callers share one instance (both ``map_tokenize``
-        and the BART serving path load through here)."""
-        key = os.path.abspath(path)
+        (absolute path, file mtimes): real vocabs are ~50k entries and the
+        BPE merge cache only pays off if callers share one instance (both
+        ``map_tokenize`` and the BART serving path load through here). The
+        cache holds at most ``_DIR_CACHE_MAX`` tokenizers (LRU) and an
+        edited vocab/merges pair reloads on the next call.
+
+        Malformed inputs raise ValueError (callers' soft-error class) — a
+        non-dict vocab.json must not escape as an AttributeError later.
+        """
+        vocab_path = os.path.join(path, "vocab.json")
+        merges_path = os.path.join(path, "merges.txt")
+        key = (
+            os.path.abspath(path),
+            os.path.getmtime(vocab_path),
+            os.path.getmtime(merges_path),
+        )
         with _dir_cache_lock:
             hit = _dir_cache.get(key)
-        if hit is not None:
-            return hit
-        with open(os.path.join(path, "vocab.json"), encoding="utf-8") as f:
+            if hit is not None:
+                _dir_cache.move_to_end(key)
+                return hit
+        with open(vocab_path, encoding="utf-8") as f:
             vocab = json.load(f)
+        if not isinstance(vocab, dict):
+            raise ValueError(
+                f"vocab.json must hold a token->id object, got "
+                f"{type(vocab).__name__}"
+            )
         merges: List[Tuple[str, str]] = []
-        with open(os.path.join(path, "merges.txt"), encoding="utf-8") as f:
+        with open(merges_path, encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
                 if not line or line.startswith("#version"):
@@ -86,6 +109,9 @@ class ByteLevelBPE:
         tok = cls(vocab, merges)
         with _dir_cache_lock:
             _dir_cache[key] = tok
+            _dir_cache.move_to_end(key)
+            while len(_dir_cache) > _DIR_CACHE_MAX:
+                _dir_cache.popitem(last=False)
         return tok
 
     def _bpe(self, token: str) -> List[str]:

@@ -1,0 +1,84 @@
+"""Sink validate/merge tools (``data/sink.py``): the consumer side of the
+``output_uri`` shard-file contract."""
+
+import json
+
+import pytest
+
+from agent_tpu.data.sink import main as sink_main
+from agent_tpu.data.sink import merge_sink, scan_sink, validate_sink
+
+
+def _write_shard(d, op, start, rows):
+    path = d / f"{op}_rows_{start:012d}.jsonl"
+    path.write_text(
+        "".join(json.dumps({"row": start + i}) + "\n" for i in range(rows))
+    )
+    return path
+
+
+def test_validate_and_merge_roundtrip(tmp_path):
+    for start, n in [(0, 4), (4, 4), (8, 2)]:
+        _write_shard(tmp_path, "map_summarize", start, n)
+    _write_shard(tmp_path, "map_classify_tpu", 0, 3)  # other op: ignored
+
+    out = validate_sink(str(tmp_path), "map_summarize", total_rows=10)
+    assert out["shards"] == 3 and out["rows"] == 10
+
+    merged = tmp_path / "merged.jsonl"
+    merge_sink(str(tmp_path), "map_summarize", str(merged), total_rows=10)
+    rows = [json.loads(ln) for ln in merged.read_text().splitlines()]
+    assert [r["row"] for r in rows] == list(range(10))  # dataset row order
+
+
+def test_validate_detects_gap_overlap_and_total(tmp_path):
+    _write_shard(tmp_path, "op", 0, 4)
+    _write_shard(tmp_path, "op", 8, 2)  # rows 4..7 missing
+    with pytest.raises(ValueError, match="gap"):
+        validate_sink(str(tmp_path), "op")
+
+    _write_shard(tmp_path, "op", 4, 5)  # covers 4..8 → overlaps shard at 8
+    with pytest.raises(ValueError, match="overlap"):
+        validate_sink(str(tmp_path), "op")
+
+    d2 = tmp_path / "short"
+    d2.mkdir()
+    _write_shard(d2, "op", 0, 4)
+    with pytest.raises(ValueError, match="mismatch"):
+        validate_sink(str(d2), "op", total_rows=9)
+    with pytest.raises(ValueError, match="no 'missing_op'"):
+        validate_sink(str(d2), "missing_op")
+
+
+def test_cli_shapes(tmp_path, capsys):
+    _write_shard(tmp_path, "op", 0, 2)
+    rc = sink_main(["validate", str(tmp_path), "--op", "op",
+                    "--total-rows", "2"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"] is True and out["rows"] == 2
+
+    rc = sink_main(["validate", str(tmp_path), "--op", "op",
+                    "--total-rows", "5"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["ok"] is False and "mismatch" in out["error"]
+
+
+def test_validates_real_op_output(tmp_path):
+    """End to end with the actual classify sink writer."""
+    from agent_tpu.ops import get_op
+    from agent_tpu.runtime.context import OpContext
+    from agent_tpu.runtime.runtime import get_runtime
+
+    classify = get_op("map_classify_tpu")
+    ctx = OpContext(runtime=get_runtime())
+    for start in (0, 3):
+        out = classify(
+            {"texts": [f"row {start + i}" for i in range(3)],
+             "output_uri": str(tmp_path), "start_row": start,
+             "allow_fallback": False},
+            ctx,
+        )
+        assert out["ok"] is True
+    summary = validate_sink(str(tmp_path), "map_classify_tpu", total_rows=6)
+    assert summary["rows"] == 6
+    assert len(scan_sink(str(tmp_path), "map_classify_tpu")) == 2
