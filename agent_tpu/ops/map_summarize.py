@@ -59,44 +59,50 @@ def _get_cfg(payload: Dict[str, Any]):
 
 
 def _resolve_family(model_id: str) -> str:
-    """``model_path`` pointing at a local HF BART checkpoint directory serves
-    the pretrained family — the reference's actual summarize model
-    (ref ``ops/map_summarize.py:29-32``); else the in-house seq2seq.
+    """``model_path`` pointing at a local HF checkpoint directory serves the
+    pretrained family: BART (the reference's actual summarize model, ref
+    ``ops/map_summarize.py:29-32``) or T5 (the family BASELINE.json names);
+    else the in-house seq2seq.
 
-    Any OTHER checkpoint directory (an HF dir whose model_type isn't bart)
+    Any OTHER checkpoint directory (an HF dir of a different model_type)
     fails the shard loudly: silently serving seeded random weights for what
     was unambiguously a checkpoint would return ok=true nonsense."""
-    from agent_tpu.models import bart, bert
+    from agent_tpu.models import bart, bert, t5
 
     if bart.is_hf_bart_dir(model_id):
         return "bart"
+    if t5.is_hf_t5_dir(model_id):
+        return "t5"
     if bert.is_hf_dir(model_id):  # generic "HF checkpoint dir" detector
         raise RuntimeError(
             f"model_path {model_id!r} is a checkpoint directory but not a "
-            "BART one (map_summarize serves model_type=bart; classify "
-            "serves BERT)"
+            "BART/T5 one (map_summarize serves model_type=bart|t5; "
+            "classify serves BERT)"
         )
     return "seq2seq"
 
 
 # model_config fields a payload may override for a checkpoint model:
 # serving controls only (structural fields are the checkpoint's).
-_BART_SERVING_OVERRIDES = ("dtype",)
+_CKPT_SERVING_OVERRIDES = ("dtype",)
 
 
-def _get_bart_cfg(model_id: str, payload: Dict[str, Any]):
+def _get_ckpt_cfg(model_id: str, payload: Dict[str, Any], family: str):
     import os as _os
 
-    from agent_tpu.models.bart import BartConfig
+    if family == "t5":
+        from agent_tpu.models.t5 import T5Config as config_cls
+    else:
+        from agent_tpu.models.bart import BartConfig as config_cls
 
     overrides = payload.get("model_config")
     allowed = {}
     if isinstance(overrides, dict):
         allowed = {
             k: v for k, v in overrides.items()
-            if k in _BART_SERVING_OVERRIDES
+            if k in _CKPT_SERVING_OVERRIDES
         }
-    return BartConfig.from_hf_json(
+    return config_cls.from_hf_json(
         _os.path.join(model_id, "config.json"), **allowed
     )
 
@@ -106,6 +112,11 @@ def _build_params(model_id: str, cfg, family: str = "seq2seq"):
         from agent_tpu.models import bart
 
         _, params = bart.load_hf_dir(model_id, dtype=cfg.dtype)
+        return params
+    if family == "t5":
+        from agent_tpu.models import t5
+
+        _, params = t5.load_hf_dir(model_id, dtype=cfg.dtype)
         return params
     from agent_tpu.models import seq2seq
 
@@ -133,6 +144,14 @@ def _stage_chunks(dp: int, texts: List[str], cfg,
         def encode_pad(chunk, lb, bb):
             return bart.encode_pad_batch(tok, chunk, cfg, bb, lb)
 
+    elif family == "t5":
+        from agent_tpu.models import t5
+
+        sp = t5.hf_spm(model_id)  # gated: actionable error sans sentencepiece
+
+        def encode_pad(chunk, lb, bb):
+            return t5.encode_pad_batch(sp, chunk, cfg, bb, lb)
+
     return stage_text_chunks(
         dp, texts, max_len=cfg.max_src_len, vocab_size=cfg.vocab_size,
         max_batch=MAX_BATCH, add_bos=True, add_eos=True,
@@ -156,10 +175,12 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
     from agent_tpu.parallel.shardings import (
         bart_param_specs,
         seq2seq_param_specs,
+        t5_param_specs,
     )
 
     specs = (
         bart_param_specs(cfg) if family == "bart"
+        else t5_param_specs(cfg) if family == "t5"
         else seq2seq_param_specs(cfg)
     )
     # tp>1 mesh → weights land sharded, same serving-path TP as classify.
@@ -185,6 +206,16 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                 gen = lambda p, i, m: bart.generate(  # noqa: E731
                     p, i, m, cfg, max_new, num_beams=num_beams,
                     attn_fn=attn_fn,
+                )
+            elif family == "t5":
+                from agent_tpu.models import t5
+
+                # No attn_fn: T5's attention carries an additive relative-
+                # position bias the mask-only attn_fn contract (ring/flash)
+                # cannot express yet, so the encoder runs the dense path
+                # regardless of the mesh. Known, documented limitation.
+                gen = lambda p, i, m: t5.generate(  # noqa: E731
+                    p, i, m, cfg, max_new, num_beams=num_beams,
                 )
             else:
                 gen = (
@@ -279,8 +310,8 @@ def stage(payload: Any, ctx: Optional[object] = None):
     # Checkpoint-integrity problems (unreadable config.json) raise past the
     # soft-error handlers on purpose: retryable shard failure, not bad input.
     cfg = (
-        _get_bart_cfg(model_id, payload) if family == "bart"
-        else _get_cfg(payload)
+        _get_ckpt_cfg(model_id, payload, family)
+        if family in ("bart", "t5") else _get_cfg(payload)
     )
     max_new = min(max_new, cfg.max_tgt_len)
 
@@ -359,7 +390,23 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
     """Host phase: detokenize fetched token rows, write the sink, shape the
     result. Safe off the device thread (reads numpy arrays only)."""
     summaries: List[str] = []
-    if state["family"] == "bart":
+    if state["family"] == "t5":
+        from agent_tpu.models import t5
+
+        cfg = state["cfg"]
+        sp = t5.hf_spm(state["model_id"])
+        n_pieces = sp.GetPieceSize()
+        # Same id set transformers' skip_special_tokens drops — incl. unk.
+        skip = {cfg.pad_id, cfg.eos_id, sp.unk_id()}
+        for toks in state["token_chunks"]:
+            summaries.extend(
+                sp.DecodeIds(
+                    [int(t) for t in row
+                     if int(t) not in skip and int(t) < n_pieces]
+                ).strip()
+                for row in toks
+            )
+    elif state["family"] == "bart":
         from agent_tpu.models import bart
 
         cfg = state["cfg"]

@@ -122,6 +122,45 @@ def seq2seq_param_specs(cfg) -> Params:
     }
 
 
+def t5_param_specs(cfg) -> Params:
+    """PartitionSpec pytree matching ``models.t5.from_state_dict`` — T5's
+    bias-free linears are bare [in, out] leaves: q/k/v and the FFN inputs
+    column-parallel, output projections row-parallel; RMSNorm scales and the
+    tiny relative-bias tables replicate; vocab-dim sharding for the
+    embedding (and untied lm_head)."""
+    col, row = P(None, "tp"), P("tp", None)
+
+    def attn():
+        return {"q": col, "k": col, "v": col, "o": row}
+
+    def blk(cross: bool):
+        ffn = (
+            {"wi_0": col, "wi_1": col, "wo": row}
+            if cfg.gated_ffn else {"wi": col, "wo": row}
+        )
+        p: Params = {"attn": attn(), "ln1": P(), "ffn": ffn, "ln2": P()}
+        if cross:
+            p["cross"] = attn()
+            p["ln_x"] = P()
+        return p
+
+    def branch(n: int, cross: bool):
+        return {
+            "rel_bias": P(),
+            "layers": [blk(cross) for _ in range(n)],
+            "ln_f": P(),
+        }
+
+    out: Params = {
+        "embed": P("tp", None),
+        "enc": branch(cfg.n_enc_layers, cross=False),
+        "dec": branch(cfg.n_dec_layers, cross=True),
+    }
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = P(None, "tp")
+    return out
+
+
 def bart_param_specs(cfg) -> Params:
     """PartitionSpec pytree matching ``models.bart.from_state_dict`` — the
     same column/row pattern as :func:`bert_param_specs`, vocab-dim sharding
