@@ -13,7 +13,8 @@ Layering (bottom-up; see SURVEY.md §7 for the design rationale):
 - ``agent_tpu.sizing``     topology-derived batching/sharding + worker profile
   (successor of reference ``worker_sizing.py``).
 - ``agent_tpu.parallel``   sharding specs, collectives, ring attention, pipeline.
-- ``agent_tpu.models``     tokenizers and Flax model families (encoder, seq2seq, LM).
+- ``agent_tpu.models``     tokenizers and pure-JAX model families (encoder,
+  seq2seq, HF BERT/BART/T5 imports) with shared decode engines.
 - ``agent_tpu.data``       byte-offset CSV sharding + double-buffered prefetch
   (successor of reference ``ops/csv_shard.py`` skip-scan reader).
 - ``agent_tpu.ops``        the op registry and the op set (successor of reference
