@@ -15,8 +15,13 @@ shards straight into HBM with host-side double buffering"):
   phase — every device touch stays on this one thread, preserving the
   single-owner invariant the reference called the "TPU RULE" (reference
   ``app.py:286``; SURVEY.md §5.2). No forks, no process pools.
-- **poster thread**: runs ``finalize`` (numpy → JSON shapes) and posts the
-  result over its own HTTP session.
+- **poster thread**: runs ``finalize`` — which for the model ops also pays
+  the deferred device→host result fetch (reading a ``jax.Array`` is
+  thread-safe; only dispatch is owner-bound), then numpy → JSON shapes —
+  and posts the result over its own HTTP session. Deferring the fetch here
+  is what lets the device thread dispatch shard i+1 while shard i's
+  round trip is in flight; the bounded post queue caps how many unfetched
+  shards may be pinned at once.
 
 Ops advertise phases as attributes on their registered handler
 (``fn.stage/.execute/.finalize``, see ``ops/map_classify_tpu.py``); ops
@@ -77,7 +82,11 @@ class PipelineRunner:
         self.agent = agent
         self.depth = max(1, depth)
         self.staged_q: "queue.Queue" = queue.Queue(maxsize=self.depth)
-        self.post_q: "queue.Queue" = queue.Queue()
+        # Bounded like staged_q: with deferred fetch (ops returning
+        # unfetched device arrays from execute), this bound is what caps
+        # in-flight shards — an unbounded post queue would pin device
+        # output buffers without limit when the poster falls behind.
+        self.post_q: "queue.Queue" = queue.Queue(maxsize=self.depth + 1)
         self.tasks_posted = 0
         self._stager = threading.Thread(
             target=self._stage_loop, name="agent-stager", daemon=True
@@ -162,6 +171,19 @@ class PipelineRunner:
 
     # ---- device (calling) thread ----
 
+    def _put_post(self, item: Any) -> bool:
+        """Blocking put into the bounded post queue. Blocking here is the
+        backpressure that caps in-flight shards (ops defer their device→host
+        fetch to the poster, so every queued item pins device buffers); bail
+        only if the poster thread died, where blocking would deadlock."""
+        while True:
+            try:
+                self.post_q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                if not self._poster.is_alive():
+                    return False  # lease TTL re-queues the task
+
     def _execute_loop(self) -> None:
         agent = self.agent
         try:
@@ -170,7 +192,7 @@ class PipelineRunner:
                 if item is _STOP:
                     break
                 if item.result is not None or item.status == "failed":
-                    self.post_q.put(item)
+                    self._put_post(item)
                     continue
                 try:
                     # profiled_call covers phased ops too — PROFILE_DIR
@@ -190,9 +212,9 @@ class PipelineRunner:
                     item.error = structured_error(exc)
                     agent.rate.log("exec", "op raised", op=item.op,
                                    type=type(exc).__name__)
-                self.post_q.put(item)
+                self._put_post(item)
         finally:
-            self.post_q.put(_STOP)  # same lost-sentinel guard as the stager
+            self._put_post(_STOP)  # same lost-sentinel guard as the stager
 
     # ---- poster thread ----
 

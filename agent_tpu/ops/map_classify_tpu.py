@@ -21,11 +21,14 @@ the mesh ``dp`` axis, and a jit-compiled executable is cached per
 The op is **phase-split** for the pipelined drain (BASELINE.json "host-side
 double buffering"): :func:`stage` (pure host — payload validation, CSV shard
 read, fused tokenize+pad), :func:`execute` (device — params, compiled
-dispatch, fetch), :func:`finalize` (pure host — numpy → JSON-shaped result).
+dispatch; with ``allow_fallback`` also the result fetch), :func:`finalize`
+(host — result shaping; in the no-fallback drain mode it also pays the
+deferred device→host fetch, which is a thread-safe READ of device arrays).
 ``run`` composes all three, so monolithic callers see the classic contract;
 the agent's pipeline runs stage/finalize on worker threads and keeps every
-device touch in ``execute`` on the owning thread (single-owner invariant,
-SURVEY.md §5.2).
+device *dispatch* in ``execute`` on the owning thread (single-owner
+invariant, SURVEY.md §5.2 — ownership governs dispatch/mesh mutation, not
+reads of results).
 
 Degraded mode is *better* than the reference's: the reference's fallback never
 computes (empty topk, ``CONTRACT.md:26`` "fallback handled elsewhere"); ours
@@ -223,9 +226,16 @@ def _stage_chunks(dp: int, items: List, kind: str, cfg,
 
 def _execute_chunks(
     runtime, chunks: List[Tuple], model_id: str, cfg, k: int,
-    family: str = "encoder",
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Device phase: classify staged chunks → (topk values [N, k], indices).
+    family: str = "encoder", fetch: bool = True,
+):
+    """Device phase: classify staged chunks.
+
+    ``fetch=True`` → (topk values [N, k] numpy, indices numpy), synced here.
+    ``fetch=False`` → the pending ``[(vals_dev, idx_dev, n), ...]`` device
+    arrays, unfetched: the pipelined drain's finalize (poster thread) syncs
+    them instead, so the device thread can dispatch the NEXT shard while
+    this one's device→host round trip is in flight (reading a jax.Array is
+    thread-safe; only dispatch is owner-bound).
 
     Top-k runs on device, fused into the forward executable: the host fetches
     k probabilities per row, not [B, n_classes] logits — at bench shapes that
@@ -284,6 +294,12 @@ def _execute_chunks(
             params, runtime.put_batch(ids), runtime.put_batch(lengths)
         )
         pending.append((vals, idx, n))
+    if not fetch:
+        return pending
+    return _fetch_pending(pending)
+
+
+def _fetch_pending(pending) -> Tuple[np.ndarray, np.ndarray]:
     all_vals = np.concatenate([np.asarray(v)[:n] for v, _, n in pending])
     all_idx = np.concatenate([np.asarray(i)[:n] for _, i, n in pending])
     return all_vals, all_idx
@@ -388,6 +404,22 @@ def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, An
             from agent_tpu.runtime.runtime import get_runtime
 
             runtime = get_runtime()
+        if not state["allow_fallback"]:
+            # Drain mode (no CPU retry promised): leave the device arrays
+            # unfetched so finalize — the pipeline's poster thread — pays
+            # the device→host round trip while THIS thread dispatches the
+            # next shard. A device failure then surfaces at fetch time and
+            # fails the shard, exactly the no-fallback contract.
+            state.update(
+                pending_dev=_execute_chunks(
+                    runtime, state["chunks"], model_id, cfg, k,
+                    family=state["family"], fetch=False,
+                ),
+                device=runtime.platform,
+                fallback_reason=None,
+                t_device=time.perf_counter(),
+            )
+            return state
         vals, idx = _execute_chunks(
             runtime, state["chunks"], model_id, cfg, k,
             family=state["family"],
@@ -447,17 +479,33 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
             out["topk"] = []
         return out
 
+    if "pending_dev" in state:
+        # Deferred fetch (no-fallback mode): sync the device results here,
+        # off the device thread. elapsed_ms keeps covering the true span;
+        # the wait is stamped as timings.fetch_ms (device_ms is dispatch
+        # only in this mode).
+        t_f = time.perf_counter()
+        vals, idx = _fetch_pending(state["pending_dev"])
+        state["fetch_ms"] = (time.perf_counter() - t_f) * 1000.0
+    else:
+        vals, idx = state["vals"], state["idx"]
+
     if ctx is not None and hasattr(ctx, "tags"):
         # Per-stage trace (SURVEY.md §5.1): staging = payload → token rows
         # (incl. shard read); queue = wait between phases (pipelined mode);
-        # device = params + transfer + compute + fetch.
+        # device = params + transfer + compute (+ fetch, except in the
+        # deferred-fetch no-fallback mode, where the fetch lands in
+        # fetch_ms on the finalize span so the device thread stays free to
+        # dispatch).
         ctx.tags.setdefault("timings", {}).update(
             stage_ms=round((state["t_staged"] - t0) * 1000.0, 3),
             queue_ms=round((state["t_exec0"] - state["t_staged"]) * 1000.0, 3),
             device_ms=round((state["t_device"] - state["t_exec0"]) * 1000.0, 3),
+            **(
+                {"fetch_ms": round(state["fetch_ms"], 3)}
+                if "fetch_ms" in state else {}
+            ),
         )
-
-    vals, idx = state["vals"], state["idx"]
     out: Dict[str, Any] = {
         "ok": True,
         "op": "map_classify_tpu",

@@ -17,18 +17,19 @@ north star is zero CPU-side model execution.
 
 Like ``map_classify_tpu``, the op is **phase-split** for the pipelined drain:
 :func:`stage` (host — validation, shard read, fused tokenize+pad),
-:func:`execute` (device — params, compiled decode, token fetch),
-:func:`finalize` (host — detokenize, sink write, result shape). The summarize
-leg of an at-scale drain therefore overlaps next-shard tokenization and
-result posting with device decode; ``run`` composes the phases for
-monolithic callers.
+:func:`execute` (device — params, compiled decode *dispatch*; the token
+arrays come back unfetched), :func:`finalize` (host — the deferred
+device→host token fetch, a thread-safe read, then detokenize, sink write,
+result shape). The summarize leg of an at-scale drain therefore overlaps
+next-shard tokenization, the previous shard's fetch, and result posting
+with device decode; ``run`` composes the phases for monolithic callers.
 """
 
 from __future__ import annotations
 
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -161,12 +162,10 @@ def _stage_chunks(dp: int, texts: List[str], cfg,
 
 def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                    max_new: int, num_beams: int,
-                   family: str = "seq2seq") -> List[np.ndarray]:
-    """Device phase: decode staged chunks → per-chunk token arrays [n, T].
-
-    Chunks dispatch asynchronously and are fetched after the loop, so host
-    staging of chunk i+1 overlaps device decode of chunk i even without the
-    pipeline (same pattern as classify's ``_execute_chunks``).
+                   family: str = "seq2seq") -> List[Tuple[Any, int]]:
+    """Device phase: decode staged chunks → pending ``[(toks_dev, n), ...]``
+    device arrays (deferred fetch — see the return comment below; same
+    pattern as classify's no-fallback mode).
     """
     import jax
 
@@ -242,7 +241,10 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
             params, runtime.put_batch(ids), runtime.put_batch(lengths)
         )
         pending.append((toks, n))
-    return [np.asarray(toks)[:n] for toks, n in pending]
+    # Unfetched: finalize (the pipeline's poster thread) syncs, so the
+    # device thread can dispatch the next shard during this one's
+    # device→host round trip (reading a jax.Array is thread-safe).
+    return pending
 
 
 def stage(payload: Any, ctx: Optional[object] = None):
@@ -389,6 +391,13 @@ def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, An
 def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, Any]:
     """Host phase: detokenize fetched token rows, write the sink, shape the
     result. Safe off the device thread (reads numpy arrays only)."""
+    # Deferred fetch: sync the device token arrays here, off the device
+    # thread (the pipeline's poster thread pays the round trip).
+    t_f = time.perf_counter()
+    token_chunks = [
+        np.asarray(toks)[:n] for toks, n in state["token_chunks"]
+    ]
+    fetch_ms = (time.perf_counter() - t_f) * 1000.0
     summaries: List[str] = []
     if state["family"] == "t5":
         from agent_tpu.models import t5
@@ -398,7 +407,7 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
         n_pieces = sp.GetPieceSize()
         # Same id set transformers' skip_special_tokens drops — incl. unk.
         skip = {cfg.pad_id, cfg.eos_id, sp.unk_id()}
-        for toks in state["token_chunks"]:
+        for toks in token_chunks:
             summaries.extend(
                 sp.DecodeIds(
                     [int(t) for t in row
@@ -417,7 +426,7 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
         unk = tok.vocab.get("<unk>")
         if unk is not None:
             skip.add(unk)
-        for toks in state["token_chunks"]:
+        for toks in token_chunks:
             summaries.extend(
                 tok.decode([t for t in row if int(t) not in skip]).strip()
                 for row in toks
@@ -426,7 +435,7 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
         from agent_tpu.models.tokenizer import ByteTokenizer
 
         tok = ByteTokenizer()
-        for toks in state["token_chunks"]:
+        for toks in token_chunks:
             summaries.extend(
                 tok.decode([t for t in row if t > 0]) for row in toks
             )
@@ -442,9 +451,12 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
             queue_ms=round(
                 (state["t_exec0"] - state["t_staged"]) * 1000.0, 3
             ),
+            # device_ms is the dispatch span; the decode's device→host sync
+            # lands in fetch_ms (deferred to this, the poster thread).
             device_ms=round(
                 (state["t_device"] - state["t_exec0"]) * 1000.0, 3
             ),
+            fetch_ms=round(fetch_ms, 3),
         )
 
     out: Dict[str, Any] = {
