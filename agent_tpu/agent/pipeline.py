@@ -174,15 +174,23 @@ class PipelineRunner:
     def _put_post(self, item: Any) -> bool:
         """Blocking put into the bounded post queue. Blocking here is the
         backpressure that caps in-flight shards (ops defer their device→host
-        fetch to the poster, so every queued item pins device buffers); bail
-        only if the poster thread died, where blocking would deadlock."""
+        fetch to the poster, so every queued item pins device buffers).
+
+        Escapes: a dead poster (blocking would deadlock), or shutdown with a
+        poster that has stopped draining (e.g. wedged in a fetch on a hung
+        device) — a graceful drain keeps consuming and frees a slot well
+        inside the grace window, so normal shutdown still posts everything."""
+        waited = 0.0
         while True:
             try:
                 self.post_q.put(item, timeout=0.5)
                 return True
             except queue.Full:
+                waited += 0.5
                 if not self._poster.is_alive():
                     return False  # lease TTL re-queues the task
+                if not self.agent.running and waited >= 30.0:
+                    return False  # wedged poster during shutdown
 
     def _execute_loop(self) -> None:
         agent = self.agent

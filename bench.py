@@ -523,30 +523,17 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
             )
             wall = _drain_until_done(agent, controller)
             check_all_ok(controller)
-            # Per-op spans from the per-stage timings the pipeline attaches
-            # (elapsed_ms of a pipelined shard includes queue wait). With
-            # deferred fetch, device_ms is dispatch only and fetch_ms holds
-            # the device→host sync the poster thread paid — their sum is
-            # the per-shard device-side span. Summarize results carry no
-            # "op" key — the reference shape {ok, summary, device, model} —
-            # so detect it by its summaries/output payload.
-            span_ms = {"map_classify_tpu": 0.0, "map_summarize": 0.0}
-            for job_id, r in controller.results().items():
-                if job_id in seen_jobs or not isinstance(r, dict):
-                    continue
-                op = r.get("op") or (
-                    "map_summarize" if "summaries" in r or "summary" in r
-                    or "map_summarize" in str(r.get("output_path", ""))
-                    else None
-                )
-                if op in span_ms:
-                    t = r.get("timings", {})
-                    if t.get("device_ms") is not None:
-                        span_ms[op] += float(t.get("device_ms", 0.0)) + float(
-                            t.get("fetch_ms", 0.0)
-                        )
-                    else:
-                        span_ms[op] += float(r.get("elapsed_ms", 0.0))
+            # Per-op spans (dispatch + deferred fetch) — single definition
+            # in agent_tpu.utils.spans, shared with drain_at_scale.py.
+            from agent_tpu.utils.spans import op_span_ms
+
+            span_ms = op_span_ms(
+                (
+                    r for job_id, r in controller.results().items()
+                    if job_id not in seen_jobs
+                ),
+                ("map_classify_tpu", "map_summarize"),
+            )
             total_rows = n_rows + DRAIN_SUMMARIZE_ROWS
             mixed_leg = {
                 "rows_per_sec": round(total_rows / wall, 1),

@@ -130,29 +130,25 @@ def main() -> int:
         PipelineRunner(agent, depth=2).run()
         wall = done.get("wall", time.perf_counter() - t_start)
 
+        from agent_tpu.utils.spans import op_span_ms, result_op
+
         counts = controller.counts()
-        # Per-shard device-side span = dispatch (device_ms) + deferred
-        # device→host sync (fetch_ms, paid on the poster thread).
-        busy_ms = {"map_classify_tpu": 0.0, "map_summarize": 0.0}
+        ok_results = []
         rows_written = {"map_classify_tpu": 0, "map_summarize": 0}
         not_ok = 0
         for r in controller.results().values():
             if not isinstance(r, dict) or r.get("ok") is not True:
                 not_ok += 1
                 continue
-            op = r.get("op") or (
-                "map_summarize" if "output_path" in r and "map_summarize"
-                in r.get("output_path", "") else None
-            )
-            if op in busy_ms:
-                t = r.get("timings", {})
-                if t.get("device_ms") is not None:
-                    busy_ms[op] += float(t.get("device_ms", 0.0)) + float(
-                        t.get("fetch_ms", 0.0)
-                    )
-                else:
-                    busy_ms[op] += float(r.get("elapsed_ms", 0.0))
+            ok_results.append(r)
+            op = result_op(r)
+            if op in rows_written:
                 rows_written[op] += int(r.get("rows_written", 0))
+        # Per-shard device-side span = dispatch + deferred fetch; single
+        # definition shared with bench.py (agent_tpu.utils.spans).
+        busy_ms = op_span_ms(
+            ok_results, ("map_classify_tpu", "map_summarize")
+        )
 
     report = {
         "rows": args.rows,

@@ -213,3 +213,27 @@ def test_columnar_degraded_shape(classify):
     # instead just assert the happy fallback keeps columnar keys.
     assert out["ok"] is True and out["fallback"] == "cpu"
     assert "indices" in out and "topk" not in out
+
+
+def test_deferred_fetch_contract(classify, ctx):
+    """No-fallback mode: execute must return UNFETCHED device results
+    (pending_dev) so the pipeline's poster thread pays the sync; fallback
+    mode keeps the fetched arrays (the CPU-retry path needs them)."""
+    from agent_tpu.ops import map_classify_tpu as op
+
+    payload = {"texts": ["deferred row a", "deferred row b"], "topk": 2}
+
+    phase, state = op.stage(dict(payload, allow_fallback=False), ctx)
+    assert phase == "staged"
+    state = op.execute(state, ctx)
+    assert "pending_dev" in state and "vals" not in state
+    out = op.finalize(state, ctx)
+    assert out["ok"] is True and len(out["results"]) == 2
+    assert ctx.tags["timings"]["fetch_ms"] >= 0
+
+    phase, state = op.stage(dict(payload, allow_fallback=True), ctx)
+    state = op.execute(state, ctx)
+    assert "vals" in state and "pending_dev" not in state
+    want = op.finalize(state, ctx)
+    assert [e["index"] for e in want["topk"]] == \
+        [e["index"] for e in out["topk"]]
