@@ -69,6 +69,10 @@ class _Item:
 
 _STOP = object()
 
+# How long a shutting-down device thread keeps waiting for the poster to free
+# a post-queue slot before giving up (wedged-poster escape; see _put_post).
+SHUTDOWN_GRACE_SEC = 30.0
+
 
 class PipelineRunner:
     """Owns the stager/poster threads around the caller's device loop.
@@ -186,10 +190,17 @@ class PipelineRunner:
                 self.post_q.put(item, timeout=0.5)
                 return True
             except queue.Full:
-                waited += 0.5
                 if not self._poster.is_alive():
                     return False  # lease TTL re-queues the task
-                if not self.agent.running and waited >= 30.0:
+                if self.agent.running:
+                    # Normal backpressure: only POST-shutdown waiting counts
+                    # against the grace window, else a slow-but-draining
+                    # poster could have an item dropped the instant
+                    # shutdown begins.
+                    waited = 0.0
+                    continue
+                waited += 0.5
+                if waited >= SHUTDOWN_GRACE_SEC:
                     return False  # wedged poster during shutdown
 
     def _execute_loop(self) -> None:

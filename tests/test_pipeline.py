@@ -225,3 +225,33 @@ def test_serial_loop_still_default_for_max_steps(runtime):
         agent._profile = {"tier": "test"}
         agent.run(max_steps=3)
     assert c.counts() == {"succeeded": 1}
+
+
+def test_wedged_poster_does_not_hang_shutdown(monkeypatch):
+    """If the poster thread stops draining (e.g. a deferred fetch wedged on
+    a hung device) while the post queue is full, a shutdown must still get
+    the device thread out of _put_post after the grace period — an agent
+    blocked there forever would hold the TPU."""
+    import queue as queue_mod
+
+    from agent_tpu.agent import pipeline as pl
+
+    monkeypatch.setattr(pl, "SHUTDOWN_GRACE_SEC", 1.0)
+
+    class StubAgent:
+        running = False  # shutdown already requested
+
+    class StubPoster:
+        @staticmethod
+        def is_alive():
+            return True  # alive but not draining: the wedge
+
+    runner = pl.PipelineRunner.__new__(pl.PipelineRunner)
+    runner.agent = StubAgent()
+    runner._poster = StubPoster()
+    runner.post_q = queue_mod.Queue(maxsize=1)
+    runner.post_q.put("occupied")  # full; nothing will ever drain it
+
+    t0 = time.time()
+    assert runner._put_post("item") is False
+    assert time.time() - t0 < 10  # escaped within the (shrunk) grace window
