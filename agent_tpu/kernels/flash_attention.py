@@ -284,6 +284,166 @@ def flash_fold(q, k, v, mask, m, l, acc, *, block_q: int = 512,
       acc.astype(jnp.float32))
 
 
+def _flash_t5_kernel(q_ref, k_ref, v_ref, mask_ref, bias_ref, o_ref,
+                     m_scr, l_scr, acc_scr, *, scale: float, n_k: int,
+                     bq: int, bk: int, num_buckets: int, max_distance: int,
+                     bidirectional: bool, n_heads: int):
+    """Flash attention with T5's bucketed relative-position bias computed
+    PER TILE in VMEM — the [H, Lq, Lk] bias tensor never exists in HBM
+    (at 16 heads × 8k² it alone would be 4 GB, defeating the kernel).
+
+    ``bias_ref`` is this head's [num_buckets, 1] learned bias column. The
+    tile's bucket map comes from absolute tile offsets (grid coords × block
+    sizes + iota); the gather from the 32-entry table is an unrolled
+    one-hot accumulation (Mosaic has no vectorized gather; 32 masked adds
+    per tile cost ~VPU parity with the tile's MXU work).
+    """
+    kb = pl.program_id(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    qi = pl.program_id(2)
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    # The ONE bucket definition (models/t5.py, HF semantics) traces fine
+    # inside the kernel — plain jnp ops; trace-time import avoids a cycle.
+    from agent_tpu.models.t5 import relative_position_bucket
+
+    bucket = relative_position_bucket(
+        k_pos - q_pos, bidirectional, num_buckets, max_distance
+    )
+
+    # The whole [num_buckets, H] table rides in VMEM (tiny; Mosaic requires
+    # full-dim blocks for its shape). This head's column is selected with a
+    # one-hot reduction (Mosaic lowers neither dynamic_slice nor gathers):
+    # cols[b, 0] = table[b, head].
+    head = pl.program_id(1)
+    h_iota = jax.lax.broadcasted_iota(jnp.int32, (1, n_heads), 1)
+    head_1h = (h_iota == head).astype(jnp.float32)            # [1, H]
+    cols = jnp.sum(bias_ref[:, :] * head_1h, axis=1, keepdims=True)
+    bias = jnp.zeros((bq, bk), dtype=jnp.float32)
+    for b in range(num_buckets):  # static unroll: one-hot gather
+        bias += jnp.where(bucket == b, cols[b, 0], 0.0)
+
+    s = jax.lax.dot_general(
+        q_ref[0, 0], k_ref[0, 0],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * scale + bias
+    keep = mask_ref[0, 0, :][None, :] > 0
+    s = jnp.where(keep, s, NEG_INF)
+
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new) * keep
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0, 0],
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kb == n_k - 1)
+    def _emit():
+        o_ref[0, 0] = (
+            acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def flash_attention_t5(
+    q: jax.Array,          # [B, H, Lq, D]
+    k: jax.Array,          # [B, H, Lk, D]
+    v: jax.Array,          # [B, H, Lk, D]
+    mask: jax.Array,       # [B|1, 1, 1, Lk] key-padding mask (1 = attend)
+    rel_bias: jax.Array,   # [num_buckets, H] learned bias table
+    *,
+    bidirectional: bool = True,
+    max_distance: int = 128,
+    scale: float = 1.0,    # T5 attention is unscaled
+    block_q: int = 512,
+    block_k: int = 512,
+    min_key_len: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Fused T5-style attention (scores·scale + bucketed relative bias →
+    masked streaming softmax → ·V). Returns the [B, H, Lq, D] context, or
+    **None** for unsupported shapes — the caller keeps its own dense path
+    (the trace-time None keeps selection visible to the model code instead
+    of silently diverging here).
+    """
+    from agent_tpu.models.layers import is_key_padding_mask
+
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    num_buckets = int(rel_bias.shape[0])
+    bq = min(block_q, Lq)
+    bk = min(block_k, Lk)
+    if min_key_len is None:
+        min_key_len = FLASH_MIN_KEY_LEN
+    supported = (
+        is_key_padding_mask(mask, B, Lk)
+        and Lk >= min_key_len
+        and Lq % bq == 0
+        and Lk % bk == 0
+        and rel_bias.ndim == 2
+        and rel_bias.shape[1] == H
+    )
+    SELECTION_COUNTS["t5_flash" if supported else "t5_dense"] = (
+        SELECTION_COUNTS.get("t5_flash" if supported else "t5_dense", 0) + 1
+    )
+    if not supported:
+        return None
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    mask3d = jnp.broadcast_to(mask[:, 0, :, :], (B, 1, Lk)).astype(jnp.int32)
+    n_q, n_k = Lq // bq, Lk // bk
+    kernel = functools.partial(
+        _flash_t5_kernel, scale=scale, n_k=n_k, bq=bq, bk=bk,
+        num_buckets=num_buckets, max_distance=max_distance,
+        bidirectional=bidirectional, n_heads=H,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, j),
+                         memory_space=pltpu.VMEM),
+            # The whole bias table (tiny): Mosaic requires the last two
+            # block dims divisible by (8, 128) OR equal to the full array
+            # dims — only the latter fits [num_buckets, H].
+            pl.BlockSpec((num_buckets, H), lambda b, h, i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * Lq * Lk * D,
+            bytes_accessed=(2 * B * H * Lq * D + 2 * B * H * Lk * D)
+            * q.dtype.itemsize,
+            transcendentals=B * H * Lq * Lk,
+        ),
+        interpret=interpret,
+    )(q, k, v, mask3d, rel_bias.astype(jnp.float32))
+
+
 def make_flash_attention(mesh):
     """Mesh-aware flash attention: the kernel wrapped in ``shard_map``.
 

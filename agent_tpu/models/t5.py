@@ -198,18 +198,70 @@ def _pad_bias(mask: jax.Array) -> jax.Array:
 
 
 def encode(params: Params, src_ids: jax.Array, src_mask: jax.Array,
-           cfg: T5Config) -> jax.Array:
-    """Encoder stack → [B, Ls, d]."""
+           cfg: T5Config, use_flash: Optional[bool] = None) -> jax.Array:
+    """Encoder stack → [B, Ls, d].
+
+    ``use_flash`` (default: auto — on when tracing for a TPU backend) routes
+    each layer's self-attention through the fused Pallas T5 kernel
+    (``kernels.flash_attention.flash_attention_t5``), which computes the
+    bucketed relative-position bias per tile in VMEM instead of
+    materializing the [H, Ls, Ls] bias in HBM — the long-context path. The
+    kernel declines unsupported shapes (returns None at trace time) and the
+    layer falls back to the dense path with a lazily built dense bias;
+    kernel == dense is asserted in tests.
+    """
     dtype = cfg.compute_dtype
-    L = src_ids.shape[1]
+    B, L = src_ids.shape
+    if use_flash is None:
+        # Bare pallas_call has no GSPMD partitioning rule: on a multi-chip
+        # mesh it would silently all-gather and replicate per chip (see
+        # kernels.make_flash_attention), so auto only opts in single-chip
+        # TPU traces; multi-chip callers must wrap/shard explicitly.
+        use_flash = (
+            jax.default_backend() == "tpu" and jax.device_count() == 1
+        )
     x = jnp.asarray(params["embed"]).astype(dtype)[src_ids]
-    pos = jnp.arange(L, dtype=jnp.int32)
-    bias = _position_bias(
-        params["enc"]["rel_bias"], pos, pos, True, cfg
-    ) + _pad_bias(src_mask)
-    for blk in params["enc"]["layers"]:
+    rel_bias = jnp.asarray(params["enc"]["rel_bias"])
+    mask4 = src_mask[:, None, None, :].astype(jnp.int32)
+    dense_bias = None  # built only when the dense path is taken
+
+    def heads(t):
+        return t.reshape(B, L, cfg.n_heads, cfg.d_kv).transpose(0, 2, 1, 3)
+
+    for i, blk in enumerate(params["enc"]["layers"]):
         h = _rms(blk["ln1"], x, cfg.layer_norm_eps)
-        x = x + _attn(blk["attn"], h, h, bias, cfg, Lq=L, Lk=L)
+        a = blk["attn"]
+        q = heads(_dense(a["q"], h, dtype))
+        k = heads(_dense(a["k"], h, dtype))
+        v = heads(_dense(a["v"], h, dtype))
+        ctx = None
+        if use_flash:
+            from agent_tpu.kernels.flash_attention import flash_attention_t5
+
+            ctx = flash_attention_t5(
+                q, k, v, mask4, rel_bias,
+                bidirectional=True, max_distance=cfg.rel_max_distance,
+                scale=1.0,
+            )
+            if i == 0 and ctx is None:
+                # The gate is shape-static and identical for every layer:
+                # decide once so fallback traces don't re-attempt per layer
+                # (and the selection counter ticks once per program).
+                use_flash = False
+        if ctx is None:
+            if dense_bias is None:
+                pos = jnp.arange(L, dtype=jnp.int32)
+                dense_bias = _position_bias(
+                    rel_bias, pos, pos, True, cfg
+                ) + _pad_bias(src_mask)
+            # Dense path on the SAME q/k/v (T5: unscaled scores + bias).
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
+                jnp.float32
+            ) + dense_bias
+            probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, L, cfg.n_heads * cfg.d_kv)
+        x = x + _dense(a["o"], ctx, dtype)
         h = _rms(blk["ln2"], x, cfg.layer_norm_eps)
         x = x + _ffn(blk["ffn"], h, cfg)
     return _rms(params["enc"]["ln_f"], x, cfg.layer_norm_eps)

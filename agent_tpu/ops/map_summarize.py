@@ -209,10 +209,12 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
             elif family == "t5":
                 from agent_tpu.models import t5
 
-                # No attn_fn: T5's attention carries an additive relative-
-                # position bias the mask-only attn_fn contract (ring/flash)
-                # cannot express yet, so the encoder runs the dense path
-                # regardless of the mesh. Known, documented limitation.
+                # No generic attn_fn: T5's bias-carrying attention has its
+                # own fused path — t5.encode routes long-context self-
+                # attention through the dedicated Pallas kernel
+                # (flash_attention_t5, bias computed per tile in VMEM) and
+                # falls back to dense for short/unsupported shapes. Ring-
+                # over-sp composition remains a known limitation.
                 gen = lambda p, i, m: t5.generate(  # noqa: E731
                     p, i, m, cfg, max_new, num_beams=num_beams,
                 )
