@@ -444,6 +444,78 @@ def flash_attention_t5(
     )(q, k, v, mask3d, rel_bias.astype(jnp.float32))
 
 
+def make_flash_attention_t5(mesh):
+    """Mesh-aware T5 kernel: ``flash_attention_t5`` wrapped in ``shard_map``
+    (batch over ``dp``, heads over ``tp`` — the bias table's head dim shards
+    with the heads). Same rationale as :func:`make_flash_attention`:
+    ``pallas_call`` has no GSPMD partitioning rule, so the bare kernel on a
+    multi-chip mesh would replicate the full batch per chip. Returns a
+    callable with the kernel's signature that yields **None** (dense
+    fallback) for shapes the wrapper can't shard or the kernel declines.
+    """
+    if mesh.size == 1:
+        return flash_attention_t5
+
+    from jax.sharding import PartitionSpec as P
+
+    shape = dict(mesh.shape)
+    dp = shape.get("dp", 1)
+    tp = shape.get("tp", 1)
+
+    def wrapper(q, k, v, mask, rel_bias, *, bidirectional=True,
+                max_distance=128, scale=1.0, block_q=512, block_k=512,
+                min_key_len=None, interpret=None):
+        from agent_tpu.models.layers import (
+            is_key_padding_mask,
+            materialize_key_padding_mask,
+        )
+
+        B, H, Lq, D = q.shape
+        Lk = k.shape[2]
+        if min_key_len is None:
+            min_key_len = FLASH_MIN_KEY_LEN
+        ok = (
+            is_key_padding_mask(mask, B, Lk)
+            and Lk >= min_key_len
+            and Lq % min(block_q, Lq) == 0
+            and Lk % min(block_k, Lk) == 0
+            and B % dp == 0
+            and H % tp == 0
+            and rel_bias.shape[1] == H
+        )
+        SELECTION_COUNTS["t5_flash" if ok else "t5_dense"] = (
+            SELECTION_COUNTS.get("t5_flash" if ok else "t5_dense", 0) + 1
+        )
+        if not ok:
+            return None
+
+        inner = functools.partial(
+            flash_attention_t5,
+            bidirectional=bidirectional, max_distance=max_distance,
+            scale=scale, block_q=block_q, block_k=block_k,
+            min_key_len=0,  # validated above, on the GLOBAL shapes
+            interpret=interpret,
+        )
+        sharded = jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(
+                P("dp", "tp", None, None),
+                P("dp", "tp", None, None),
+                P("dp", "tp", None, None),
+                P("dp", None, None, None),
+                P(None, "tp"),   # bias table: head dim shards with heads
+            ),
+            out_specs=P("dp", "tp", None, None),
+            check_vma=False,  # pallas out_shape carries no vma annotation
+        )
+        return sharded(
+            q, k, v, materialize_key_padding_mask(mask, B, Lk), rel_bias
+        )
+
+    return wrapper
+
+
 def make_flash_attention(mesh):
     """Mesh-aware flash attention: the kernel wrapped in ``shard_map``.
 

@@ -198,28 +198,32 @@ def _pad_bias(mask: jax.Array) -> jax.Array:
 
 
 def encode(params: Params, src_ids: jax.Array, src_mask: jax.Array,
-           cfg: T5Config, use_flash: Optional[bool] = None) -> jax.Array:
+           cfg: T5Config, use_flash: Optional[bool] = None,
+           kernel=None) -> jax.Array:
     """Encoder stack → [B, Ls, d].
 
-    ``use_flash`` (default: auto — on when tracing for a TPU backend) routes
-    each layer's self-attention through the fused Pallas T5 kernel
-    (``kernels.flash_attention.flash_attention_t5``), which computes the
-    bucketed relative-position bias per tile in VMEM instead of
-    materializing the [H, Ls, Ls] bias in HBM — the long-context path. The
-    kernel declines unsupported shapes (returns None at trace time) and the
-    layer falls back to the dense path with a lazily built dense bias;
-    kernel == dense is asserted in tests.
+    Long-context path: self-attention routes through the fused Pallas T5
+    kernel, which computes the bucketed relative-position bias per tile in
+    VMEM instead of materializing the [H, Ls, Ls] bias in HBM. ``kernel``
+    lets the caller pass a mesh-aware wrapper
+    (``kernels.make_flash_attention_t5(mesh)`` — batch over dp, heads over
+    tp); with ``kernel=None``, ``use_flash`` (default: auto — single-chip
+    TPU traces only, since bare ``pallas_call`` has no GSPMD partitioning
+    rule) selects the plain kernel. Either declines unsupported shapes at
+    trace time (returns None) and the layer falls back to the dense path
+    with a lazily built dense bias; kernel == dense is asserted in tests.
     """
     dtype = cfg.compute_dtype
     B, L = src_ids.shape
-    if use_flash is None:
-        # Bare pallas_call has no GSPMD partitioning rule: on a multi-chip
-        # mesh it would silently all-gather and replicate per chip (see
-        # kernels.make_flash_attention), so auto only opts in single-chip
-        # TPU traces; multi-chip callers must wrap/shard explicitly.
-        use_flash = (
-            jax.default_backend() == "tpu" and jax.device_count() == 1
-        )
+    if kernel is None:
+        if use_flash is None:
+            use_flash = (
+                jax.default_backend() == "tpu" and jax.device_count() == 1
+            )
+        if use_flash:
+            from agent_tpu.kernels.flash_attention import flash_attention_t5
+
+            kernel = flash_attention_t5
     x = jnp.asarray(params["embed"]).astype(dtype)[src_ids]
     rel_bias = jnp.asarray(params["enc"]["rel_bias"])
     mask4 = src_mask[:, None, None, :].astype(jnp.int32)
@@ -235,10 +239,8 @@ def encode(params: Params, src_ids: jax.Array, src_mask: jax.Array,
         k = heads(_dense(a["k"], h, dtype))
         v = heads(_dense(a["v"], h, dtype))
         ctx = None
-        if use_flash:
-            from agent_tpu.kernels.flash_attention import flash_attention_t5
-
-            ctx = flash_attention_t5(
+        if kernel is not None:
+            ctx = kernel(
                 q, k, v, mask4, rel_bias,
                 bidirectional=True, max_distance=cfg.rel_max_distance,
                 scale=1.0,
@@ -247,7 +249,7 @@ def encode(params: Params, src_ids: jax.Array, src_mask: jax.Array,
                 # The gate is shape-static and identical for every layer:
                 # decide once so fallback traces don't re-attempt per layer
                 # (and the selection counter ticks once per program).
-                use_flash = False
+                kernel = None
         if ctx is None:
             if dense_bias is None:
                 pos = jnp.arange(L, dtype=jnp.int32)
