@@ -95,6 +95,15 @@ class BartConfig:
                         hf[key] = gen[key]
             except json.JSONDecodeError:
                 pass  # optional overlay; config.json remains authoritative
+        # _ffn hardcodes exact GELU (the bart-base/large value); a checkpoint
+        # with any other activation_function would be silently mis-served, so
+        # whitelist and fail loudly (retryable integrity error).
+        act = hf.get("activation_function", "gelu")
+        if act != "gelu":
+            raise RuntimeError(
+                f"unsupported BART activation_function={act!r} "
+                "(supported: 'gelu')"
+            )
         fields = dict(
             vocab_size=hf["vocab_size"],
             d_model=hf["d_model"],

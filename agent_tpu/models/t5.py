@@ -81,6 +81,15 @@ class T5Config:
                 f"not a T5 checkpoint (model_type={hf.get('model_type')!r})"
             )
         proj = hf.get("feed_forward_proj", "relu")
+        # Whitelist, don't approximate: a 'gelu' or 'gated-silu' checkpoint
+        # served through the wrong activation would return ok=true with wrong
+        # numerics — fail loudly as a retryable integrity error instead (same
+        # contract as the model_type check above).
+        if proj not in ("relu", "gated-gelu"):
+            raise RuntimeError(
+                f"unsupported T5 feed_forward_proj={proj!r} "
+                "(supported: 'relu', 'gated-gelu')"
+            )
         fields = dict(
             vocab_size=hf["vocab_size"],
             d_model=hf["d_model"],

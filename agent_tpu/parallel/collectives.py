@@ -63,12 +63,14 @@ def mesh_reduce_stats(runtime, values: Sequence[float]) -> Dict[str, Any]:
     ``ops/risk_accumulate.py:70-77`` shape); the caller adds ``ok``/timing.
 
     Numerics contract: inputs ship as a double-single (hi/lo f32) pair, so
-    there is NO input-cast error vs the host ``math.fsum`` path; the residual
-    is f32 *accumulation* error of the shard-local sums, worst-case relative
-    ``n · 2⁻²⁴`` and in practice far smaller (XLA reduces in trees). The
-    controller-side merge path stays exact (``risk_accumulate`` host fsum);
-    this device path trades that last-ulp exactness for on-chip reduction
-    over ICI.
+    there is NO input-cast error vs the host ``math.fsum`` path for the
+    **sum** (the residual is f32 *accumulation* error of the shard-local
+    sums, worst-case relative ``n · 2⁻²⁴`` and in practice far smaller — XLA
+    reduces in trees). **min/max are computed over the hi component only**,
+    so they can differ from the exact f64 host path by one f32 rounding ulp
+    of the extreme value. The controller-side merge path stays exact
+    (``risk_accumulate`` host fsum); this device path trades that last-ulp
+    exactness for on-chip reduction over ICI.
     """
     n = len(values)
     if n == 0:

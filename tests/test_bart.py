@@ -222,3 +222,23 @@ def test_beam_runs_and_returns_shapes(hf_dir):
     toks, lengths = bart.generate(params, src, mask, cfg, 5, num_beams=3)
     assert np.asarray(toks).shape == (2, 5)
     assert np.asarray(lengths).shape == (2,)
+
+
+def test_unsupported_activation_function_fails_loudly(tmp_path):
+    """_ffn hardcodes exact GELU; any other activation_function must raise
+    rather than mis-serve (advisor r3, low)."""
+    import json
+
+    cfg = dict(
+        model_type="bart", vocab_size=32, d_model=8,
+        encoder_attention_heads=2, encoder_layers=1, decoder_layers=1,
+        encoder_ffn_dim=16, max_position_embeddings=64,
+        activation_function="relu",
+    )
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps(cfg))
+    with pytest.raises(RuntimeError, match="activation_function"):
+        bart.BartConfig.from_hf_json(str(p))
+    cfg["activation_function"] = "gelu"
+    p.write_text(json.dumps(cfg))
+    assert bart.BartConfig.from_hf_json(str(p)).d_model == 8

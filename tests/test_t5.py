@@ -228,3 +228,29 @@ def test_encode_flash_equals_dense(tmp_path, monkeypatch):
     assert fa.SELECTION_COUNTS.get("t5_flash", 0) > before.get("t5_flash", 0)
     dense = np.asarray(t5.encode(params, src, mask, cfg, use_flash=False))
     np.testing.assert_allclose(flash, dense, atol=3e-5)
+
+
+def test_unsupported_feed_forward_proj_fails_loudly(tmp_path):
+    """A checkpoint whose activation we can't honor must FAIL, not silently
+    serve a different activation with ok=true (advisor r3, medium)."""
+    import json
+
+    cfg = dict(
+        model_type="t5", vocab_size=32, d_model=8, d_kv=4, num_heads=2,
+        num_layers=1, d_ff=16, feed_forward_proj="gelu",
+    )
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps(cfg))
+    with pytest.raises(RuntimeError, match="feed_forward_proj"):
+        t5.T5Config.from_hf_json(str(p))
+    cfg["feed_forward_proj"] = "gated-silu"
+    p.write_text(json.dumps(cfg))
+    with pytest.raises(RuntimeError, match="feed_forward_proj"):
+        t5.T5Config.from_hf_json(str(p))
+    # The two supported values still load.
+    cfg["feed_forward_proj"] = "gated-gelu"
+    p.write_text(json.dumps(cfg))
+    assert t5.T5Config.from_hf_json(str(p)).gated_ffn is True
+    cfg["feed_forward_proj"] = "relu"
+    p.write_text(json.dumps(cfg))
+    assert t5.T5Config.from_hf_json(str(p)).gated_ffn is False
