@@ -49,6 +49,8 @@ class BartConfig:
     forced_eos_id: Optional[int] = 2  # HF BART forces EOS at max length
     scale_embedding: bool = False
     dtype: str = "bfloat16"
+    # "int8": serve with W8A8 quantized matmuls (models.quant).
+    quant: str = "none"
 
     # Uniform serving-config view (map_summarize reads these off any family).
     @property

@@ -50,6 +50,10 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     num_labels: int = 1000
     dtype: str = "bfloat16"
+    # "int8": serve with W8A8 quantized matmuls (models.quant) — execution
+    # mode, not a different artifact; the checkpoint weights are quantized
+    # per-channel at load.
+    quant: str = "none"
 
     # Uniform serving-config view (the classify op reads these off any family).
     @property

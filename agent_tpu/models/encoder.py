@@ -39,6 +39,10 @@ class EncoderConfig:
     max_len: int = 2048            # reference profile max_tokens (app.py:108)
     n_classes: int = 1000
     dtype: str = "bfloat16"
+    # "int8" runs the hot matmuls W8A8 on the MXU (models.quant) — the
+    # TPU-native successor of the reference's INT8 TFLite execution
+    # (reference ops/_tpu_runtime.py:23-31).
+    quant: str = "none"
 
     @property
     def compute_dtype(self):

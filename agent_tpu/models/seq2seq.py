@@ -39,6 +39,9 @@ class Seq2SeqConfig:
     max_src_len: int = 1024       # reference truncates input at 1024 (:49)
     max_tgt_len: int = 130        # reference generate max_length default (:46)
     dtype: str = "bfloat16"
+    # "int8": W8A8 quantized matmuls (models.quant) in encode AND decode —
+    # the reference's INT8 device execution, TPU-native.
+    quant: str = "none"
 
     @property
     def compute_dtype(self):

@@ -56,6 +56,8 @@ class T5Config:
     decoder_start_id: int = 0   # T5 starts decode from pad
     layer_norm_eps: float = 1e-6
     dtype: str = "bfloat16"
+    # "int8": serve with W8A8 quantized matmuls (models.quant).
+    quant: str = "none"
 
     # Uniform serving-config view (map_summarize reads these off any family).
     # T5 has no position table — length is bounded by memory, not params;
@@ -122,6 +124,10 @@ def _rms(p: jax.Array, x: jax.Array, eps: float) -> jax.Array:
 
 def _dense(w: jax.Array, x: jax.Array, dtype) -> jax.Array:
     """Bias-free linear (T5 has no biases anywhere); w is [in, out]."""
+    from agent_tpu.models import quant
+
+    if quant.is_quantized(w):  # int8 leaf (models.quant convention)
+        return quant.qdense(w, x, dtype)
     return jnp.dot(x.astype(dtype), w.astype(dtype))
 
 

@@ -35,6 +35,60 @@ def config_from_payload(payload: Dict[str, Any], config_cls):
     return config_cls()
 
 
+def apply_quant_env(payload: Dict[str, Any], cfg):
+    """Quant-mode resolution shared by the model ops: payload
+    ``model_config.quant`` wins; else ``TPU_QUANT`` env; else the config
+    default.
+
+    Error contract: a bad *payload* value raises ValueError (→ soft
+    bad_input, caller error); a bad *env* value raises RuntimeError — a
+    worker deployment misconfig must fail the shard for retry/visibility,
+    not soft-drop every task as caller error (same rule as the checkpoint
+    integrity errors, ``models/bert.py`` from_hf_json).
+    """
+    from dataclasses import replace
+
+    from agent_tpu.models.quant import validate_quant
+
+    overrides = payload.get("model_config")
+    if isinstance(overrides, dict) and "quant" in overrides:
+        # Apply the payload value here, self-contained — not via the family
+        # override whitelists (a whitelist that forgot "quant" would
+        # otherwise silently serve unquantized while this "validated" the
+        # default).
+        return replace(cfg, quant=validate_quant(overrides["quant"]))
+    env = os.environ.get("TPU_QUANT", "").strip().lower()
+    if env:
+        try:
+            return replace(cfg, quant=validate_quant(env))
+        except ValueError as exc:
+            raise RuntimeError(f"bad TPU_QUANT env: {exc}") from exc
+    return cfg
+
+
+def maybe_quantize_params(params, family: str, cfg):
+    """The shared int8 build-time transform gate (guard + dispatch), so the
+    two model ops cannot drift. Host-side quantization BEFORE HBM placement:
+    the int8 tables — 4× smaller than f32 — are what transfer and stay
+    resident (``models.quant``)."""
+    if getattr(cfg, "quant", "none") == "int8":
+        from agent_tpu.models.quant import quantize_for_family
+
+        return quantize_for_family(family, params)
+    return params
+
+
+def maybe_quantize_specs(specs, family: str, cfg):
+    """Spec-tree twin of :func:`maybe_quantize_params`: the quantized tree
+    has ``{"w_q", "w_scale"}`` leaves, so tp placement specs transform the
+    same paths."""
+    if getattr(cfg, "quant", "none") == "int8":
+        from agent_tpu.models.quant import quantize_specs_for_family
+
+        return quantize_specs_for_family(family, specs)
+    return specs
+
+
 def cfg_key(cfg) -> Tuple:
     """Hashable fingerprint of a frozen config dataclass — goes into both the
     params-store key and the executable-cache key so distinct configs never

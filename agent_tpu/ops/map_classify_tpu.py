@@ -75,12 +75,13 @@ def _resolve_family(model_id: str) -> str:
 # model: serving controls. Structural fields (num_layers, hidden_size, ...)
 # are the checkpoint's — an override there would desync the staged config
 # from the actual weights.
-_BERT_SERVING_OVERRIDES = ("dtype", "num_labels")
+_BERT_SERVING_OVERRIDES = ("dtype", "num_labels", "quant")
 
 
 def _get_bert_cfg(model_id: str, payload: Dict[str, Any]):
     """BertConfig from the checkpoint's config.json; payload ``model_config``
-    may override only the serving controls (``dtype``, ``num_labels``)."""
+    may override only the serving controls (``_BERT_SERVING_OVERRIDES``:
+    ``dtype``, ``num_labels``, ``quant``)."""
     import os as _os
 
     from agent_tpu.models.bert import BertConfig
@@ -113,12 +114,16 @@ def _build_params(model_id: str, cfg, family: str = "encoder"):
         _, params = bert.load_hf_dir(
             model_id, dtype=cfg.dtype, num_labels=cfg.num_labels
         )
-        return params
-    from agent_tpu.models import encoder
+    else:
+        from agent_tpu.models import encoder
 
-    if model_id.endswith(".npz") and os.path.exists(model_id):
-        return encoder.load_npz(model_id, cfg)
-    return encoder.init_params(cfg, model_id=model_id)
+        if model_id.endswith(".npz") and os.path.exists(model_id):
+            params = encoder.load_npz(model_id, cfg)
+        else:
+            params = encoder.init_params(cfg, model_id=model_id)
+    from agent_tpu.ops._model_common import maybe_quantize_params
+
+    return maybe_quantize_params(params, family, cfg)
 
 
 def _collect_sequences(payload: Dict[str, Any], cfg) -> Tuple[List, str, bool]:
@@ -257,6 +262,9 @@ def _execute_chunks(
     else:
         model_mod = encoder
         specs = encoder_param_specs(cfg)
+    from agent_tpu.ops._model_common import maybe_quantize_specs
+
+    specs = maybe_quantize_specs(specs, family, cfg)
 
     # On a tp>1 mesh the weights land sharded (Megatron-style specs) and XLA
     # inserts the tp collectives in the forward — the serving path for models
@@ -352,6 +360,9 @@ def stage(payload: Any, ctx: Optional[object] = None):
             _get_bert_cfg(model_id, payload) if family == "bert"
             else _get_cfg(payload)
         )
+        from agent_tpu.ops._model_common import apply_quant_env
+
+        cfg = apply_quant_env(payload, cfg)
         items, kind, single = _collect_sequences(payload, cfg)
         from agent_tpu.ops._model_common import (
             validate_output_uri,

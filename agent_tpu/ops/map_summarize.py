@@ -85,7 +85,7 @@ def _resolve_family(model_id: str) -> str:
 
 # model_config fields a payload may override for a checkpoint model:
 # serving controls only (structural fields are the checkpoint's).
-_CKPT_SERVING_OVERRIDES = ("dtype",)
+_CKPT_SERVING_OVERRIDES = ("dtype", "quant")
 
 
 def _get_ckpt_cfg(model_id: str, payload: Dict[str, Any], family: str):
@@ -113,17 +113,20 @@ def _build_params(model_id: str, cfg, family: str = "seq2seq"):
         from agent_tpu.models import bart
 
         _, params = bart.load_hf_dir(model_id, dtype=cfg.dtype)
-        return params
-    if family == "t5":
+    elif family == "t5":
         from agent_tpu.models import t5
 
         _, params = t5.load_hf_dir(model_id, dtype=cfg.dtype)
-        return params
-    from agent_tpu.models import seq2seq
+    else:
+        from agent_tpu.models import seq2seq
 
-    if model_id.endswith(".npz") and os.path.exists(model_id):
-        return seq2seq.load_npz(model_id, cfg)
-    return seq2seq.init_params(cfg, model_id=model_id)
+        if model_id.endswith(".npz") and os.path.exists(model_id):
+            params = seq2seq.load_npz(model_id, cfg)
+        else:
+            params = seq2seq.init_params(cfg, model_id=model_id)
+    from agent_tpu.ops._model_common import maybe_quantize_params
+
+    return maybe_quantize_params(params, family, cfg)
 
 
 MAX_BATCH = 1024
@@ -182,6 +185,9 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
         else t5_param_specs(cfg) if family == "t5"
         else seq2seq_param_specs(cfg)
     )
+    from agent_tpu.ops._model_common import maybe_quantize_specs
+
+    specs = maybe_quantize_specs(specs, family, cfg)
     # tp>1 mesh → weights land sharded, same serving-path TP as classify.
     params = runtime.get_params(
         f"{model_id}#{family}#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}",
@@ -317,6 +323,12 @@ def stage(payload: Any, ctx: Optional[object] = None):
         _get_ckpt_cfg(model_id, payload, family)
         if family in ("bart", "t5") else _get_cfg(payload)
     )
+    try:
+        from agent_tpu.ops._model_common import apply_quant_env
+
+        cfg = apply_quant_env(payload, cfg)
+    except ValueError as exc:
+        return "done", bad_input(str(exc))
     max_new = min(max_new, cfg.max_tgt_len)
 
     from agent_tpu.config import OpsConfig
