@@ -55,6 +55,23 @@ FLASH_MIN_KEY_LEN = 2048
 SELECTION_COUNTS = {"flash": 0, "dense": 0}
 
 
+def selects_flash(seq_len: int, *, block: int = 512,
+                  min_key_len: Optional[int] = None) -> bool:
+    """Shape-only predicate: will self-attention at ``seq_len`` (Lq == Lk,
+    conforming key-padding mask, default tiles) take the Pallas path?
+
+    Mirrors the ``supported`` gate in :func:`flash_attention` — staging code
+    (``ops._model_common.split_padded_chunk``) uses it to budget dense-path
+    dispatch chunks without touching device state, so a ≥2048 length that the
+    kernel would still reject (not tile-divisible → dense fallback) is
+    correctly treated as dense there too."""
+    if min_key_len is None:
+        min_key_len = FLASH_MIN_KEY_LEN
+    if seq_len < min_key_len:
+        return False
+    return seq_len % min(block, seq_len) == 0
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, scale: float, n_k: int):
     # Streaming-softmax update mirrored in agent_tpu.parallel.ring (fold) —

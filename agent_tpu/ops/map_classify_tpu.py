@@ -214,18 +214,23 @@ def _stage_chunks(dp: int, items: List, kind: str, cfg,
         return stage_text_chunks(
             dp, items, max_len=cfg.max_len, vocab_size=cfg.vocab_size,
             max_batch=MAX_BATCH, encode_pad=encode_pad,
+            split_for_dispatch=True,
         )
     # Length buckets must not exceed the position table (max_len).
     buckets = length_buckets_for(cfg.max_len)
     bbuckets = batch_buckets(dp, MAX_BATCH)
     wire_dtype = np.uint16 if cfg.vocab_size <= (1 << 16) else np.int32
     chunks: List[Tuple] = []
+    from agent_tpu.ops._model_common import split_padded_chunk
+
     for chunk in iter_chunks(items, bbuckets[-1]):
         ids, _ = pad_batch(chunk, buckets=buckets, batch_buckets=bbuckets)
         B, L = ids.shape
         lengths = np.zeros(B, dtype=np.int32)
         lengths[: len(chunk)] = [min(len(s), L) for s in chunk]
-        chunks.append((ids.astype(wire_dtype), lengths, len(chunk)))
+        chunks.extend(
+            split_padded_chunk(ids.astype(wire_dtype), lengths, len(chunk), dp)
+        )
     return chunks
 
 
@@ -236,8 +241,9 @@ def _execute_chunks(
     """Device phase: classify staged chunks.
 
     ``fetch=True`` → (topk values [N, k] numpy, indices numpy), synced here.
-    ``fetch=False`` → the pending ``[(vals_dev, idx_dev, n), ...]`` device
-    arrays, unfetched: the pipelined drain's finalize (poster thread) syncs
+    ``fetch=False`` → the pending device arrays, unfetched — one
+    ``(vals_dev, idx_dev, n)`` entry, or ``("cat", vals_dev, idx_dev,
+    layout)`` when several dispatch chunks were gathered on device: the pipelined drain's finalize (poster thread) syncs
     them instead, so the device thread can dispatch the NEXT shard while
     this one's device→host round trip is in flight (reading a jax.Array is
     thread-safe; only dispatch is owner-bound).
@@ -302,15 +308,61 @@ def _execute_chunks(
             params, runtime.put_batch(ids), runtime.put_batch(lengths)
         )
         pending.append((vals, idx, n))
+    if len(pending) > 1:
+        # Gather the chunk results on DEVICE here, on the dispatching
+        # (owner) thread: each host read of a device array is a full tunnel
+        # round trip, so fetching 16 chunks separately would pay 32 round
+        # trips where two suffice — and in pipelined no-fallback mode the
+        # fetch happens on the poster thread, which must only ever READ
+        # device arrays (single-owner dispatch invariant, agent/pipeline.py).
+        vals_d, idx_d = _concat_pending()(
+            [v for v, _, _ in pending], [i for _, i, _ in pending]
+        )
+        pending = [("cat", vals_d, idx_d,
+                    [(v.shape[0], n) for v, _, n in pending])]
     if not fetch:
         return pending
     return _fetch_pending(pending)
 
 
+_concat_fn = None
+
+
+def _concat_pending():
+    """Module-cached jitted device concat (jit reuses its own executable
+    cache per chunk-shape signature). Called from the dispatching thread
+    ONLY — see the single-owner note in :func:`_execute_chunks`."""
+    global _concat_fn
+    if _concat_fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        _concat_fn = jax.jit(
+            lambda vs, idxs: (
+                jnp.concatenate(vs, axis=0),
+                jnp.concatenate(idxs, axis=0),
+            )
+        )
+    return _concat_fn
+
+
 def _fetch_pending(pending) -> Tuple[np.ndarray, np.ndarray]:
-    all_vals = np.concatenate([np.asarray(v)[:n] for v, _, n in pending])
-    all_idx = np.concatenate([np.asarray(i)[:n] for _, i, n in pending])
-    return all_vals, all_idx
+    """Sync pending device results → (vals [N, k], idx [N, k]) numpy,
+    trimming padding rows. Pure READS of device arrays (np.asarray), so the
+    pipelined poster thread may call it: multi-chunk shards were already
+    gathered into one ``("cat", vals, idx, layout)`` entry on the device
+    thread at dispatch time."""
+    if pending and len(pending[0]) == 4:  # ("cat", vals, idx, layout)
+        _, vals_d, idx_d, layout = pending[0]
+        vals, idx = np.asarray(vals_d), np.asarray(idx_d)
+        out_v, out_i, off = [], [], 0
+        for B, n in layout:
+            out_v.append(vals[off:off + n])
+            out_i.append(idx[off:off + n])
+            off += B
+        return np.concatenate(out_v), np.concatenate(out_i)
+    v, i, n = pending[0]
+    return np.asarray(v)[:n], np.asarray(i)[:n]
 
 
 def _get_cpu_runtime():

@@ -19,6 +19,10 @@ Legs (the ``legs`` object in the output line):
                      **mfu** field: achieved FLOP/s ÷ the chip's peak bf16
                      FLOP/s (looked up from device_kind, override with
                      ``BENCH_PEAK_TFLOPS``).
+- ``bert_base_int8`` — the same BERT-base leg under
+                     ``model_config {"quant": "int8"}`` (W8A8, models/quant.py)
+                     with the speedup over bf16 and the top-1 agreement rate
+                     vs bf16 on a diverse 512-row batch.
 - ``long_ctx``     — classify over 4k-token documents. The warmup *proves*
                      the compiled program contains the Pallas flash kernel by
                      diffing the kernel's trace-time selection counters
@@ -48,8 +52,12 @@ import time
 WINDOWS = 3
 FLAGSHIP_BATCH = 8192
 FLAGSHIP_ITERS = 10
-BERT_BATCH = 1024
-BERT_ITERS = 3
+# 4096-row payloads dispatch as 16 back-to-back 256-row device programs
+# (ops._model_common.split_padded_chunk) — the measured v5e sweet spot for
+# dense seq-512 attention — with ONE deferred fetch, so the tunneled
+# host↔device round trip amortizes over the whole payload.
+BERT_BATCH = 4096
+BERT_ITERS = 2
 BERT_CONFIG = {
     "d_model": 768, "n_heads": 12, "n_layers": 12, "d_ff": 3072,
     "max_len": 512,
@@ -167,9 +175,11 @@ def _bench_bert_base(runtime):
     iters = 1 if smoke else BERT_ITERS
     windows = 1 if smoke else WINDOWS
     text_len = 480
+    # quant pinned: a fleet-wide TPU_QUANT=int8 env must not silently turn
+    # the bf16 reference leg (and the int8 leg's agreement baseline) int8.
     leg = _bench_classify_leg(
         runtime, batch=batch, text_len=text_len, iters=iters,
-        windows=windows, model_config=BERT_CONFIG,
+        windows=windows, model_config={**BERT_CONFIG, "quant": "none"},
     )
     cfg = EncoderConfig(**BERT_CONFIG)
     seq = bucket_length(text_len, [b for b in DEFAULT_BUCKETS
@@ -190,6 +200,55 @@ def _bench_bert_base(runtime):
         achieved_tflops=round(achieved / 1e12, 2),
         mfu=round(achieved / peak, 4) if peak else None,
     )
+    return leg
+
+
+def _bench_bert_base_int8(runtime, bf16_leg):
+    """BERT-base classify with ``model_config {"quant": "int8"}`` (W8A8,
+    models/quant.py) — the reference's INT8 device story as an execution
+    mode. Records the speedup over the bf16 leg at the same batch and the
+    top-1 agreement rate vs bf16 on a diverse batch (the quantization
+    fidelity number next to the throughput number)."""
+    import numpy as np
+
+    from agent_tpu.ops import get_op
+    from agent_tpu.runtime.context import OpContext
+
+    smoke = runtime.platform != "tpu"
+    batch = 64 if smoke else BERT_BATCH
+    iters = 1 if smoke else BERT_ITERS
+    windows = 1 if smoke else WINDOWS
+    leg = _bench_classify_leg(
+        runtime, batch=batch, text_len=480, iters=iters, windows=windows,
+        model_config={**BERT_CONFIG, "quant": "int8"},
+    )
+    if bf16_leg and bf16_leg.get("rows_per_sec"):
+        leg["speedup_vs_bf16"] = round(
+            leg["rows_per_sec"] / bf16_leg["rows_per_sec"], 3
+        )
+
+    # Top-1 agreement on a diverse batch: per-row distinct content so the
+    # argmax isn't one degenerate class. Same texts through both modes.
+    classify = get_op("map_classify_tpu")
+    ctx = OpContext(runtime=runtime)
+    rng = np.random.default_rng(7)
+    words = ["alpha", "risk", "ledger", "breach", "routine", "audit",
+             "wire", "flag", "normal", "urgent", "invoice", "metric"]
+    texts = [
+        " ".join(rng.choice(words, size=60).tolist()) + f" case {i}"
+        for i in range(512 if not smoke else 64)
+    ]
+    payload = {"texts": texts, "topk": 1, "allow_fallback": False,
+               "result_format": "columnar",
+               "model_config": {**BERT_CONFIG, "quant": "none"}}
+    ref = classify(payload, ctx)
+    q = classify({**payload,
+                  "model_config": {**BERT_CONFIG, "quant": "int8"}}, ctx)
+    assert ref["ok"] is True and q["ok"] is True, (ref, q)
+    top1_ref = np.asarray(ref["indices"])[:, 0]
+    top1_q = np.asarray(q["indices"])[:, 0]
+    leg["agreement_top1"] = round(float((top1_ref == top1_q).mean()), 4)
+    leg["agreement_rows"] = len(texts)
     return leg
 
 
@@ -562,6 +621,8 @@ def main() -> int:
 
     for name, fn in (
         ("bert_base", lambda: _bench_bert_base(runtime)),
+        ("bert_base_int8", lambda: _bench_bert_base_int8(
+            runtime, legs.get("bert_base"))),
         ("long_ctx", lambda: _bench_long_ctx(runtime)),
         ("train", lambda: _bench_train(runtime)),
         ("summarize", lambda: _bench_summarize(runtime)),
@@ -626,6 +687,12 @@ def main() -> int:
                 "classify_p50_batch_ms": flagship["p50_batch_ms"],
                 "bert_base_rows_per_sec": legs["bert_base"].get("rows_per_sec"),
                 "bert_base_mfu": legs["bert_base"].get("mfu"),
+                "bert_base_int8_rows_per_sec": legs["bert_base_int8"].get(
+                    "rows_per_sec"
+                ),
+                "int8_agreement_top1": legs["bert_base_int8"].get(
+                    "agreement_top1"
+                ),
                 "long_ctx_rows_per_sec": legs["long_ctx"].get("rows_per_sec"),
                 "train_examples_per_sec": legs["train"].get("examples_per_sec"),
                 "train_mfu": legs["train"].get("mfu"),

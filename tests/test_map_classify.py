@@ -237,3 +237,53 @@ def test_deferred_fetch_contract(classify, ctx):
     want = op.finalize(state, ctx)
     assert [e["index"] for e in want["topk"]] == \
         [e["index"] for e in out["topk"]]
+
+
+def test_split_padded_chunk_unit(monkeypatch):
+    """Dense-path dispatch splitting: budget respected, slices are batch
+    buckets dividing the parent, real-row accounting exact, flash lengths
+    and under-budget chunks untouched."""
+    from agent_tpu.ops._model_common import split_padded_chunk
+
+    ids = np.arange(64 * 128, dtype=np.uint16).reshape(64, 128)
+    lengths = np.full(64, 100, dtype=np.int32)
+    lengths[50:] = 0  # 50 real rows, 14 padding rows
+
+    out = split_padded_chunk(ids, lengths, 50, dp=2)  # budget >> 64*128
+    assert len(out) == 1 and out[0][2] == 50
+
+    monkeypatch.setenv("TPU_CHUNK_TOKENS", str(16 * 128))  # 16-row slices
+    out = split_padded_chunk(ids, lengths, 50, dp=2)
+    assert [o[0].shape[0] for o in out] == [16, 16, 16, 16]
+    assert [o[2] for o in out] == [16, 16, 16, 2]  # 50 real rows
+    # Row content preserved in order.
+    np.testing.assert_array_equal(np.concatenate([o[0] for o in out]), ids)
+
+    # dp floor: even when dp alone exceeds the budget, slices stay dp.
+    monkeypatch.setenv("TPU_CHUNK_TOKENS", "8")
+    out = split_padded_chunk(ids, lengths, 50, dp=4)
+    assert all(o[0].shape[0] == 4 for o in out)
+
+    # Flash-path lengths are never split...
+    monkeypatch.setenv("TPU_CHUNK_TOKENS", "128")
+    big = np.zeros((8, 2048), dtype=np.uint16)
+    out = split_padded_chunk(big, np.ones(8, np.int32), 8, dp=1)
+    assert len(out) == 1
+    # ...but a ≥2048 length the kernel would REJECT (not tile-divisible →
+    # dense fallback) is treated as dense and split.
+    odd = np.zeros((8, 3000), dtype=np.uint16)
+    out = split_padded_chunk(odd, np.ones(8, np.int32), 8, dp=1)
+    assert len(out) == 8  # budget 128 tokens → 1-row slices
+
+
+def test_split_dispatch_results_align(classify, ctx, monkeypatch):
+    """A payload that splits into several device slices must return exactly
+    the same per-row results as the unsplit dispatch (order and values)."""
+    texts = ["split alignment row %03d" % i for i in range(37)]
+    payload = {"texts": texts, "topk": 3, "result_format": "columnar"}
+    want = classify(dict(payload), ctx)
+    monkeypatch.setenv("TPU_CHUNK_TOKENS", "512")  # force tiny slices
+    got = classify(dict(payload), ctx)
+    assert got["ok"] and want["ok"]
+    assert got["indices"] == want["indices"]
+    np.testing.assert_allclose(got["scores"], want["scores"], atol=1e-5)
