@@ -13,6 +13,16 @@ test mesh exercises identical code (same-program-different-backend rule,
 SURVEY.md §7).
 """
 
-from agent_tpu.kernels.flash_attention import flash_attention, make_flash_attention
+from agent_tpu.kernels.flash_attention import (
+    flash_attention,
+    flash_attention_trainable,
+    make_flash_attention,
+    make_flash_attention_trainable,
+)
 
-__all__ = ["flash_attention", "make_flash_attention"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_trainable",
+    "make_flash_attention",
+    "make_flash_attention_trainable",
+]

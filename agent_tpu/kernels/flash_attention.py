@@ -533,6 +533,404 @@ def make_flash_attention_t5(mesh):
     return wrapper
 
 
+# ---------------------------------------------------------------------------
+# Trainable flash attention: custom_vjp with Pallas forward AND backward.
+#
+# The inference kernel above is forward-only — differentiating through it
+# would fail (pallas_call has no AD rule), so the training path previously
+# fell back to dense attention, materializing [B, H, L, L] scores in the
+# backward and capping train MFU well below serving. The trainable variant
+# uses the standard recompute scheme (FlashAttention-2 backward):
+#
+#   forward: one extra [B, H, Lq, 1] output — the row logsumexp
+#            ``lse = m + log(l)`` — saved as the only softmax residual;
+#   backward: ``delta = rowsum(dO ⊙ O)`` (cheap XLA reduction), then two
+#            Pallas kernels that RECOMPUTE the normalized probabilities
+#            ``p = exp(s − lse)`` per tile in VMEM:
+#              • dQ kernel, grid (B, H, n_q, n_k): stream K/V tiles,
+#                accumulate ``dq += (p ∘ (dO·Vᵀ − delta)) · K · scale``;
+#              • dK/dV kernel, grid (B, H, n_k, n_q): stream Q tiles,
+#                accumulate ``dv += pᵀ·dO`` and ``dk += dsᵀ·Q · scale``.
+#            The [Lq, Lk] score/probability matrices never exist in HBM in
+#            either direction.
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_lse_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                          m_scr, l_scr, acc_scr, *, scale: float, n_k: int):
+    """:func:`_flash_kernel` + one extra output: the row logsumexp residual."""
+    kb = pl.program_id(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    s = jax.lax.dot_general(
+        q_ref[0, 0], k_ref[0, 0],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * scale
+    keep = mask_ref[0, 0, :][None, :] > 0
+    s = jnp.where(keep, s, NEG_INF)
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new) * keep
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0, 0],
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kb == n_k - 1)
+    def _emit():
+        l_fin = l_scr[:, :1]
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l_fin, 1e-30)).astype(
+            o_ref.dtype
+        )
+        # Fully-masked rows: m == NEG_INF, l == 0 → lse ≈ NEG_INF − 69; the
+        # backward's exp(s − lse) would overflow there but is zeroed by the
+        # key mask before use.
+        lse_ref[0, 0] = m_scr[:, :1] + jnp.log(jnp.maximum(l_fin, 1e-30))
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                         delta_ref, dq_ref, dq_scr, *, scale: float,
+                         n_k: int):
+    """dQ for one query tile, streaming K/V tiles on the inner grid axis."""
+    kb = pl.program_id(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    s = jax.lax.dot_general(                                  # [bq, bk]
+        q_ref[0, 0], k_ref[0, 0],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * scale
+    keep = mask_ref[0, 0, :][None, :] > 0
+    # Normalized probabilities, recomputed from the saved logsumexp. The
+    # clamp bounds exp() for fully-masked rows (lse ≈ NEG_INF) where the
+    # mask zeroes p anyway — exp(80) is finite in f32, so no inf*0.
+    p = jnp.where(
+        keep, jnp.exp(jnp.minimum(s - lse_ref[0, 0], 80.0)), 0.0
+    )
+    dp = jax.lax.dot_general(                                 # dO · Vᵀ
+        do_ref[0, 0], v_ref[0, 0],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0, 0])                           # [bq, bk] f32
+    dq_scr[:] += scale * jax.lax.dot_general(
+        ds.astype(k_ref.dtype), k_ref[0, 0],
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kb == n_k - 1)
+    def _emit():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          scale: float, n_q: int):
+    """dK and dV for one key tile, streaming Q tiles on the inner grid axis."""
+    qb = pl.program_id(3)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    s = jax.lax.dot_general(                                  # [bq, bk]
+        q_ref[0, 0], k_ref[0, 0],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * scale
+    keep = mask_ref[0, 0, :][None, :] > 0
+    p = jnp.where(
+        keep, jnp.exp(jnp.minimum(s - lse_ref[0, 0], 80.0)), 0.0
+    )
+    # dV += pᵀ · dO — explicit .T then dot: the Mosaic-supported transposed
+    # contraction (same pattern as jax.experimental.pallas.ops.tpu).
+    dv_scr[:] += jax.lax.dot(
+        p.T.astype(do_ref.dtype), do_ref[0, 0],
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(                                 # dO · Vᵀ
+        do_ref[0, 0], v_ref[0, 0],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0, 0])
+    dk_scr[:] += scale * jax.lax.dot(
+        ds.T.astype(q_ref.dtype), q_ref[0, 0],
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(qb == n_q - 1)
+    def _emit():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_fwd_res(q, k, v, mask3d, *, block_q, block_k, interpret, scale):
+    """Forward pallas_call emitting (output, [B, H, Lq, 1] logsumexp)."""
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    bq = min(block_q, Lq)
+    bk = min(block_k, Lk)
+    n_q, n_k = Lq // bq, Lk // bk
+    qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM)
+    sspec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_flash_fwd_lse_kernel, scale=scale, n_k=n_k),
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            qspec, kspec, kspec,
+            pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            sspec,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, Lq, 1), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * Lq * Lk * D,
+            bytes_accessed=(2 * B * H * Lq * D + 2 * B * H * Lk * D)
+            * q.dtype.itemsize,
+            transcendentals=B * H * Lq * Lk,
+        ),
+        interpret=interpret,
+    )(q, k, v, mask3d)
+
+
+def _flash_bwd_res(q, k, v, mask3d, o, lse, do, *, block_q, block_k,
+                   interpret, scale):
+    """Backward: (dq, dk, dv) via the two streaming kernels."""
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    bq = min(block_q, Lq)
+    bk = min(block_k, Lk)
+    n_q, n_k = Lq // bq, Lk // bk
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )                                                          # [B, H, Lq, 1]
+
+    def qtile(b, h, i, j):
+        return (b, h, i, 0)
+
+    def ktile(b, h, i, j):
+        return (b, h, j, 0)
+
+    qspec = pl.BlockSpec((1, 1, bq, D), qtile, memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, 1, bk, D), ktile, memory_space=pltpu.VMEM)
+    sspec = pl.BlockSpec((1, 1, bq, 1), qtile, memory_space=pltpu.VMEM)
+    mspec = pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, j),
+                         memory_space=pltpu.VMEM)
+    bwd_cost = pl.CostEstimate(
+        flops=10 * B * H * Lq * Lk * D,
+        bytes_accessed=(4 * B * H * Lq * D + 4 * B * H * Lk * D)
+        * q.dtype.itemsize,
+        transcendentals=2 * B * H * Lq * Lk,
+    )
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, n_k=n_k),
+        grid=(B, H, n_q, n_k),
+        in_specs=[qspec, kspec, kspec, mspec, qspec, sspec, sspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        cost_estimate=bwd_cost,
+        interpret=interpret,
+    )(q, k, v, mask3d, do, lse, delta)
+
+    # K-tile outer, Q-tile inner: swap the roles of the last two grid axes.
+    def qtile_t(b, h, j, i):
+        return (b, h, i, 0)
+
+    def ktile_t(b, h, j, i):
+        return (b, h, j, 0)
+
+    qspec_t = pl.BlockSpec((1, 1, bq, D), qtile_t, memory_space=pltpu.VMEM)
+    kspec_t = pl.BlockSpec((1, 1, bk, D), ktile_t, memory_space=pltpu.VMEM)
+    sspec_t = pl.BlockSpec((1, 1, bq, 1), qtile_t, memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, n_q=n_q),
+        grid=(B, H, n_k, n_q),
+        in_specs=[
+            qspec_t, kspec_t, kspec_t,
+            pl.BlockSpec((1, 1, bk), lambda b, h, j, i: (b, 0, j),
+                         memory_space=pltpu.VMEM),
+            qspec_t, sspec_t, sspec_t,
+        ],
+        out_specs=(kspec_t, kspec_t),
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        cost_estimate=bwd_cost,
+        interpret=interpret,
+    )(q, k, v, mask3d, do, lse, delta)
+    return dq, dk, dv
+
+
+def flash_attention_trainable(
+    q: jax.Array,      # [B, H, Lq, D]
+    k: jax.Array,      # [B, H, Lk, D]
+    v: jax.Array,      # [B, H, Lk, D]
+    mask: jax.Array,   # [B|1, 1, 1, Lk] key-padding mask (1 = attend)
+    *,
+    block_q: int = 512,
+    block_k: int = 512,
+    min_key_len: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Differentiable drop-in ``attn_fn``: Pallas forward AND backward.
+
+    Same selection gate and numerics as :func:`flash_attention`; unsupported
+    shapes take the dense XLA path, which autodiff handles natively. The
+    Pallas path registers a ``custom_vjp`` whose backward runs the two
+    streaming kernels above — training at long context no longer
+    materializes [Lq, Lk] score matrices in either pass.
+
+    Gradient caveat: rows whose mask keeps NO keys get zero (dq, dk, dv)
+    contributions here, while the dense path backpropagates through its
+    uniform-softmax-then-zero guard; with any real key present the two
+    paths agree to dtype tolerance (``tests/test_flash_attention.py``).
+    """
+    from agent_tpu.models.layers import is_key_padding_mask
+
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    bq = min(block_q, Lq)
+    bk = min(block_k, Lk)
+    if min_key_len is None:
+        min_key_len = FLASH_MIN_KEY_LEN
+    supported = (
+        is_key_padding_mask(mask, B, Lk)
+        and Lk >= min_key_len
+        and Lq % bq == 0
+        and Lk % bk == 0
+    )
+    key = "flash_train" if supported else "dense_train"
+    SELECTION_COUNTS[key] = SELECTION_COUNTS.get(key, 0) + 1
+    if not supported:
+        return dot_product_attention(q, k, v, mask)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = 1.0 / float(np.sqrt(D))
+    mask3d = jnp.broadcast_to(mask[:, 0, :, :], (B, 1, Lk)).astype(jnp.int32)
+    return _trainable_core(block_q, block_k, interpret, scale)(
+        q, k, v, mask3d
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _trainable_core(block_q: int, block_k: int, interpret: bool,
+                    scale: float):
+    """The custom_vjp attention for one static (tiles, interpret, scale).
+
+    The mask rides as a PRIMAL argument (``None`` cotangent), never in a
+    closure: a closed-over traced mask would leak its tracer into the
+    backward trace — ``jax.checkpoint`` replays the forward under a
+    different trace than the one that runs ``bwd``. The lru_cache keeps one
+    function identity per static config, so jit caches see a stable callee.
+    """
+
+    @jax.custom_vjp
+    def attn(q, k, v, mask3d):
+        o, _ = _flash_fwd_res(q, k, v, mask3d, block_q=block_q,
+                              block_k=block_k, interpret=interpret,
+                              scale=scale)
+        return o
+
+    def fwd(q, k, v, mask3d):
+        o, lse = _flash_fwd_res(q, k, v, mask3d, block_q=block_q,
+                                block_k=block_k, interpret=interpret,
+                                scale=scale)
+        return o, (q, k, v, mask3d, o, lse)
+
+    def bwd(res, do):
+        q, k, v, mask3d, o, lse = res
+        dq, dk, dv = _flash_bwd_res(q, k, v, mask3d, o, lse, do,
+                                    block_q=block_q, block_k=block_k,
+                                    interpret=interpret, scale=scale)
+        return dq, dk, dv, None
+
+    attn.defvjp(fwd, bwd)
+    return attn
+
+
+def make_flash_attention_trainable(mesh):
+    """Mesh-aware trainable flash attention — :func:`make_flash_attention`
+    for the training path. Batch shards over ``dp``, heads over ``tp``;
+    ``shard_map`` differentiates through the per-shard ``custom_vjp``, so
+    the backward kernels also run sharded. Unsupported shapes fall back to
+    the dense path (GSPMD + autodiff handle it)."""
+    if mesh.size == 1:
+        return flash_attention_trainable
+
+    from jax.sharding import PartitionSpec as P
+
+    shape = dict(mesh.shape)
+    dp = shape.get("dp", 1)
+    tp = shape.get("tp", 1)
+
+    sharded = jax.shard_map(
+        flash_attention_trainable,
+        mesh=mesh,
+        in_specs=(
+            P("dp", "tp", None, None),
+            P("dp", "tp", None, None),
+            P("dp", "tp", None, None),
+            P("dp", None, None, None),
+        ),
+        out_specs=P("dp", "tp", None, None),
+        check_vma=False,
+    )
+
+    def mesh_flash_attention_trainable(q, k, v, mask):
+        from agent_tpu.models.layers import (
+            is_key_padding_mask,
+            materialize_key_padding_mask,
+        )
+
+        B, H, _, _ = q.shape
+        Lk = k.shape[2]
+        ok = is_key_padding_mask(mask, B, Lk) and B % dp == 0 and H % tp == 0
+        if not ok:
+            # Tick the counter here too: inside shard_map the per-shard call
+            # ticks, but this wrapper-level fallback would otherwise be
+            # invisible to the trace-time selection proof (one tick per
+            # compiled program, whichever level decided).
+            SELECTION_COUNTS["dense_train"] = (
+                SELECTION_COUNTS.get("dense_train", 0) + 1
+            )
+            return dot_product_attention(q, k, v, mask)
+        return sharded(q, k, v, materialize_key_padding_mask(mask, B, Lk))
+
+    return mesh_flash_attention_trainable
+
+
 def make_flash_attention(mesh):
     """Mesh-aware flash attention: the kernel wrapped in ``shard_map``.
 

@@ -21,21 +21,23 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from agent_tpu.models import encoder
+from agent_tpu.models import encoder, layers
 from agent_tpu.parallel import shardings
 
 
 def cross_entropy_loss(
     params, ids: jax.Array, mask: jax.Array, labels: jax.Array, cfg,
-    remat: bool = False,
+    remat: bool = False, attn_fn=None,
 ) -> jax.Array:
-    logits = encoder.forward(params, ids, mask, cfg, remat=remat)
+    attn_fn = attn_fn or layers.dot_product_attention
+    logits = encoder.forward(params, ids, mask, cfg, remat=remat,
+                             attn_fn=attn_fn)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
     return nll.mean()
 
 
-def make_train_step(cfg, optimizer=None, remat: bool = False):
+def make_train_step(cfg, optimizer=None, remat: bool = False, attn_fn=None):
     """Build ``(init_state, step)`` where ``step`` is one jitted SGD update.
 
     ``init_state(params)`` → opt_state; ``step(params, opt_state, ids, mask,
@@ -50,6 +52,11 @@ def make_train_step(cfg, optimizer=None, remat: bool = False):
     ``remat=True`` rematerializes each encoder block in the backward pass
     (``jax.checkpoint``) — required at BERT-base scale, where stored
     attention scores alone exceed one chip's HBM (see ``encoder.forward``).
+
+    ``attn_fn`` must be DIFFERENTIABLE end to end — pass
+    ``kernels.flash_attention_trainable`` (or the mesh wrapper from
+    ``runtime.train_attention_fn()``), never the forward-only inference
+    kernel, whose ``pallas_call`` has no AD rule. Default: dense attention.
     """
     optimizer = optimizer or optax.adamw(1e-3)
 
@@ -62,7 +69,7 @@ def make_train_step(cfg, optimizer=None, remat: bool = False):
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, ids, mask, labels):
         loss, grads = jax.value_and_grad(cross_entropy_loss)(
-            params, ids, mask, labels, cfg, remat
+            params, ids, mask, labels, cfg, remat, attn_fn
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -83,19 +90,26 @@ def place_sharded(runtime, params, specs) -> Any:
     )
 
 
-def train_step_sharded(runtime, cfg, batch_size: int, seq_len: int):
+def train_step_sharded(runtime, cfg, batch_size: int, seq_len: int,
+                       attn_fn=None):
     """One full sharded training step on synthetic data; returns the loss.
 
     This is the multi-chip proof path (`__graft_entry__.dryrun_multichip`):
     params sharded per ``encoder_param_specs`` (tp), batch per ``P(dp, sp)``,
     one jitted fwd+bwd+update executed on the runtime's mesh.
+
+    ``attn_fn=None`` selects via ``runtime.train_attention_fn()`` — the
+    differentiable flash kernel on TPU at ≥``FLASH_MIN_KEY_LEN``, dense
+    otherwise.
     """
     mesh = runtime.mesh
     params = encoder.init_params(cfg, model_id="train-dryrun")
     specs = shardings.encoder_param_specs(cfg)
     params = place_sharded(runtime, params, specs)
 
-    init_state, step = make_train_step(cfg)
+    init_state, step = make_train_step(
+        cfg, attn_fn=attn_fn or runtime.train_attention_fn()
+    )
     opt_state = init_state(params)
 
     rng = jax.random.PRNGKey(0)

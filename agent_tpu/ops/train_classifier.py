@@ -220,7 +220,12 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         runtime.mesh, host_params, shardings.encoder_param_specs(cfg)
     )
     params = train.place_sharded(runtime, host_params, specs)
-    init_state, step = train.make_train_step(cfg, optax.adamw(float(lr)))
+    # Differentiable attention from the runtime: the Pallas flash pair on
+    # TPU, so long-context fine-tunes (buckets ≥ 2048) never materialize
+    # [B, H, L, L] score matrices in the backward.
+    init_state, step = train.make_train_step(
+        cfg, optax.adamw(float(lr)), attn_fn=runtime.train_attention_fn()
+    )
     opt_state = init_state(params)
 
     first_epoch_loss = last_epoch_loss = None

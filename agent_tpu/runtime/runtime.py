@@ -83,6 +83,7 @@ class TpuRuntime:
         self._model_ids: set = set()
         self._params_lock = threading.Lock()
         self._attention_fn = None
+        self._train_attention_fn = None
         self.compute_dtype = self.config.compute_dtype
 
     # ---- topology ----
@@ -132,6 +133,34 @@ class TpuRuntime:
 
                 self._attention_fn = dot_product_attention
         return self._attention_fn
+
+    def train_attention_fn(self):
+        """The DIFFERENTIABLE attention kernel for the training path.
+
+        Same platform gate as :meth:`attention_fn`, but selects
+        ``kernels.make_flash_attention_trainable`` — the ``custom_vjp``
+        variant whose backward is also a Pallas kernel — instead of the
+        forward-only inference kernel (which autodiff cannot trace through).
+        Ring attention (``sp`` > 1) is forward-only today, so sp meshes train
+        on the dense path; both flash and dense degrade to dense for
+        unsupported shapes, keeping the return a safe drop-in ``attn_fn``.
+        """
+        if self._train_attention_fn is None:
+            if (
+                self.platform == "tpu"
+                and self.config.pallas_attn
+                and self.axis_size("sp") == 1
+            ):
+                from agent_tpu.kernels import make_flash_attention_trainable
+
+                self._train_attention_fn = make_flash_attention_trainable(
+                    self.mesh
+                )
+            else:
+                from agent_tpu.models.layers import dot_product_attention
+
+                self._train_attention_fn = dot_product_attention
+        return self._train_attention_fn
 
     def replicated(self) -> NamedSharding:
         return self.sharding()

@@ -369,7 +369,12 @@ def _bench_train(runtime):
     # ~39 GB at this scale; recompute them instead (flops ratio below
     # already accounts for the fwd+bwd cost, remat's extra fwd is ~free on
     # the MFU denominator side — we report achieved/peak of the 3x model).
-    init_state, step = make_train_step(cfg, remat=not smoke)
+    # train_attention_fn: the differentiable flash kernel on TPU — at seq
+    # 512 it trace-time-selects dense anyway (FLASH_MIN_KEY_LEN), but the
+    # leg exercises the product selection path, not a bench-local choice.
+    init_state, step = make_train_step(
+        cfg, remat=not smoke, attn_fn=runtime.train_attention_fn()
+    )
     opt_state = init_state(params)
     rng = np.random.default_rng(0)
     ids = runtime.put_batch(
@@ -407,6 +412,95 @@ def _bench_train(runtime):
         "spread_pct": round(spread, 2),
         "batch": batch,
         "seq_len": seq,
+        "gflops_per_example": round(flops_ex / 1e9, 2),
+        "achieved_tflops": round(achieved / 1e12, 2),
+        "mfu": round(achieved / peak, 4) if peak else None,
+    }
+
+
+TRAIN_LONG_CTX_BATCH = 16
+TRAIN_LONG_CTX_SEQ = 2048
+TRAIN_LONG_CTX_STEPS = 4
+
+
+def _bench_train_long_ctx(runtime):
+    """Long-context training (seq 2048) through the DIFFERENTIABLE Pallas
+    flash kernel — fwd and bwd both streaming, no [L, L] score matrices in
+    HBM in either direction. Asserts the ``flash_train`` selection counter
+    ticked and ``dense_train`` did not: the compiled train step provably
+    contains the kernel pair, the same proof discipline as the serving
+    ``long_ctx`` leg. This leg did not exist before the backward kernel —
+    dense-backward training at 2k+ context OOMed or crawled."""
+    import importlib
+
+    import jax
+    import numpy as np
+
+    from agent_tpu.models import encoder
+    from agent_tpu.models.encoder import EncoderConfig
+    from agent_tpu.models.train import make_train_step
+
+    fa = importlib.import_module("agent_tpu.kernels.flash_attention")
+    if runtime.platform != "tpu":
+        return {"skipped": "flash kernel only selected on real TPU"}
+
+    cfg = EncoderConfig(**{**LONG_CTX_CONFIG, "max_len": TRAIN_LONG_CTX_SEQ})
+    batch, seq, steps = (
+        TRAIN_LONG_CTX_BATCH, TRAIN_LONG_CTX_SEQ, TRAIN_LONG_CTX_STEPS,
+    )
+    params = jax.device_put(
+        encoder.init_params(cfg, model_id="bench-train-longctx"),
+        runtime.replicated(),
+    )
+    before = dict(fa.SELECTION_COUNTS)
+    init_state, step = make_train_step(
+        cfg, remat=True, attn_fn=runtime.train_attention_fn()
+    )
+    opt_state = init_state(params)
+    rng = np.random.default_rng(0)
+    ids = runtime.put_batch(
+        rng.integers(4, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    )
+    mask = runtime.put_batch(np.ones((batch, seq), dtype=np.int32))
+    labels = runtime.put_batch(
+        rng.integers(0, cfg.n_classes, (batch,)).astype(np.int32)
+    )
+    for _ in range(2):  # two warmups, same rationale as _bench_train
+        params, opt_state, loss = step(params, opt_state, ids, mask, labels)
+        float(loss)
+    flash_new = fa.SELECTION_COUNTS.get("flash_train", 0) - before.get(
+        "flash_train", 0
+    )
+    dense_new = fa.SELECTION_COUNTS.get("dense_train", 0) - before.get(
+        "dense_train", 0
+    )
+    assert flash_new > 0 and dense_new == 0, (
+        f"long-ctx train leg did not take the flash path "
+        f"(flash_train+{flash_new}, dense_train+{dense_new})"
+    )
+
+    def window():
+        nonlocal params, opt_state
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, ids, mask,
+                                           labels)
+        final = float(loss)
+        wall = time.perf_counter() - t0
+        assert final == final, "long-ctx train loss is NaN"
+        return batch * steps / wall, wall * 1000.0 / steps
+
+    ex_per_sec, step_ms, spread = _median_windows(window, WINDOWS)
+    flops_ex = 3 * encoder_flops_per_row(cfg, seq)
+    achieved = ex_per_sec * flops_ex / runtime.n_devices
+    peak = _peak_flops(runtime)
+    return {
+        "examples_per_sec": round(ex_per_sec, 1),
+        "step_ms": round(step_ms, 2),
+        "spread_pct": round(spread, 2),
+        "batch": batch,
+        "seq_len": seq,
+        "flash_train_selected": True,
         "gflops_per_example": round(flops_ex / 1e9, 2),
         "achieved_tflops": round(achieved / 1e12, 2),
         "mfu": round(achieved / peak, 4) if peak else None,
@@ -625,6 +719,7 @@ def main() -> int:
             runtime, legs.get("bert_base"))),
         ("long_ctx", lambda: _bench_long_ctx(runtime)),
         ("train", lambda: _bench_train(runtime)),
+        ("train_long_ctx", lambda: _bench_train_long_ctx(runtime)),
         ("summarize", lambda: _bench_summarize(runtime)),
     ):
         try:
@@ -696,6 +791,7 @@ def main() -> int:
                 "long_ctx_rows_per_sec": legs["long_ctx"].get("rows_per_sec"),
                 "train_examples_per_sec": legs["train"].get("examples_per_sec"),
                 "train_mfu": legs["train"].get("mfu"),
+                "train_long_ctx_mfu": legs["train_long_ctx"].get("mfu"),
                 "summarize_decode_tok_per_sec": legs["summarize"].get(
                     "decode_tok_per_sec"
                 ),

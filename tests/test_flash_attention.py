@@ -142,3 +142,167 @@ def test_encoder_forward_with_flash_matches_dense():
         np.asarray(flash_logits), np.asarray(dense_logits),
         rtol=5e-5, atol=5e-5,
     )
+
+
+# ---------------------------------------------------------------------------
+# Trainable kernel (custom_vjp: Pallas forward AND backward)
+# ---------------------------------------------------------------------------
+
+import functools
+
+import jax
+
+from agent_tpu.kernels import flash_attention_trainable
+
+
+def _train_attn(**kw):
+    return functools.partial(
+        flash_attention_trainable, min_key_len=0, interpret=True, **kw
+    )
+
+
+def _grads(attn_fn, q, k, v, mask, g):
+    def loss(q, k, v):
+        return jnp.sum(attn_fn(q, k, v, mask) * g)
+
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+def test_trainable_forward_equals_inference_kernel():
+    """Same streaming-softmax math → bit-identical forward outputs."""
+    q, k, v, mask = _qkvm(Lq=32, Lk=48, pad_tail=5, seed=6)
+    got = flash_attention_trainable(
+        q, k, v, mask, block_q=16, block_k=16, min_key_len=0, interpret=True
+    )
+    want = flash_attention(
+        q, k, v, mask, block_q=16, block_k=16, min_key_len=0, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_trainable_grads_match_dense_multi_tile():
+    """dq/dk/dv from the streaming backward kernels == autodiff through the
+    dense path, with real tile streaming (Lq, Lk > blocks) and padded keys."""
+    q, k, v, mask = _qkvm(Lq=32, Lk=48, D=8, pad_tail=5, seed=7)
+    g = jnp.asarray(
+        np.random.default_rng(8).normal(size=q.shape), dtype=jnp.float32
+    )
+    flash = _grads(_train_attn(block_q=16, block_k=16), q, k, v, mask, g)
+    dense = _grads(layers.dot_product_attention, q, k, v, mask, g)
+    for got, want in zip(flash, dense):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_trainable_grads_bfloat16():
+    q, k, v, mask = _qkvm(Lq=32, Lk=32, pad_tail=3, seed=9)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    g = jnp.asarray(
+        np.random.default_rng(10).normal(size=q.shape), dtype=jnp.bfloat16
+    )
+    flash = _grads(_train_attn(block_q=16, block_k=16), qb, kb, vb, mask, g)
+    dense = _grads(layers.dot_product_attention, qb, kb, vb, mask, g)
+    for got, want in zip(flash, dense):
+        np.testing.assert_allclose(
+            np.asarray(got).astype(np.float32),
+            np.asarray(want).astype(np.float32),
+            rtol=5e-2, atol=5e-2,
+        )
+
+
+def test_trainable_fully_masked_row_grads_finite():
+    """Documented divergence: a no-keys row contributes ZERO gradient on the
+    flash path (dense backpropagates through its uniform-softmax guard);
+    gradients must stay finite, never NaN."""
+    q, k, v, mask = _qkvm(seed=11)
+    mask = mask.at[1].set(0)
+    g = jnp.ones_like(q)
+    dq, dk, dv = _grads(_train_attn(), q, k, v, mask, g)
+    for a in (dq, dk, dv):
+        assert np.isfinite(np.asarray(a)).all()
+    np.testing.assert_array_equal(np.asarray(dq[1]), 0.0)
+
+
+def test_trainable_off_contract_falls_back_differentiable():
+    """Causal mask → dense fallback; autodiff must flow through it."""
+    q, k, v, _ = _qkvm()
+    causal = jnp.asarray(layers.causal_mask(16))
+    g = jnp.ones_like(q)
+    flash = _grads(_train_attn(), q, k, v, causal, g)
+    dense = _grads(layers.dot_product_attention, q, k, v, causal, g)
+    for got, want in zip(flash, dense):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_trainable_selection_counter_ticks():
+    import importlib
+
+    fa_mod = importlib.import_module("agent_tpu.kernels.flash_attention")
+    q, k, v, mask = _qkvm()
+    before = fa_mod.SELECTION_COUNTS.get("flash_train", 0)
+    flash_attention_trainable(q, k, v, mask, min_key_len=0, interpret=True)
+    assert fa_mod.SELECTION_COUNTS["flash_train"] == before + 1
+
+
+def test_trainable_under_remat_and_train_step():
+    """The custom_vjp must compose with jax.checkpoint and the full train
+    step: one flash-attn SGD step == one dense SGD step (loss and params)."""
+    from agent_tpu.models import encoder
+    from agent_tpu.models.train import make_train_step
+
+    cfg = encoder.EncoderConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=16, n_classes=10, dtype="float32",
+    )
+    rng = np.random.default_rng(12)
+    ids = jnp.asarray(rng.integers(0, 64, size=(4, 16)), dtype=jnp.int32)
+    mask = np.ones((4, 16), dtype=np.int32)
+    mask[:, 12:] = 0
+    mask = jnp.asarray(mask)
+    labels = jnp.asarray(rng.integers(0, 10, size=(4,)), dtype=jnp.int32)
+
+    losses, states = [], []
+    for attn_fn in (layers.dot_product_attention, _train_attn()):
+        params = encoder.init_params(cfg, model_id="trainable-flash")
+        init_state, step = make_train_step(cfg, remat=True, attn_fn=attn_fn)
+        opt_state = init_state(params)
+        params, opt_state, loss = step(params, opt_state, ids, mask, labels)
+        losses.append(float(loss))
+        states.append(params)
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+    flat_d = jax.tree_util.tree_leaves(states[0])
+    flat_f = jax.tree_util.tree_leaves(states[1])
+    for a, b in zip(flat_d, flat_f):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_mesh_trainable_grads_on_dp_tp_mesh():
+    """shard_map + custom_vjp: sharded backward == dense autodiff."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from agent_tpu.kernels import make_flash_attention_trainable
+    from agent_tpu.runtime.mesh import build_mesh
+
+    mesh = build_mesh(jax.devices()[:8], {"dp": 4, "tp": 2})
+    fn = make_flash_attention_trainable(mesh)
+    q, k, v, mask = _qkvm(B=8, H=4, Lq=16, Lk=16, D=8, pad_tail=3, seed=13)
+    g = jnp.asarray(
+        np.random.default_rng(14).normal(size=q.shape), dtype=jnp.float32
+    )
+    shard = NamedSharding(mesh, P("dp", "tp", None, None))
+    qs, ks, vs = (jax.device_put(x, shard) for x in (q, k, v))
+    ms = jax.device_put(mask, NamedSharding(mesh, P("dp", None, None, None)))
+
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v, ms) * g)
+
+    flash = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(qs, ks, vs)
+    dense = _grads(layers.dot_product_attention, q, k, v, mask, g)
+    for got, want in zip(flash, dense):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
