@@ -72,10 +72,32 @@ def selects_flash(seq_len: int, *, block: int = 512,
     return seq_len % min(block, seq_len) == 0
 
 
+def _tile_softmax_update(s, keep, v_ref, m_scr, l_scr, acc_scr) -> None:
+    """THE streaming-softmax tile fold: update VMEM state (m, l, acc) with
+    one [bq, bk] score tile. Single-sourced for every kernel in this module
+    (inference, lse-emitting trainable forward, fold, T5 bias) — and
+    mirrored in ``agent_tpu.parallel.ring``'s einsum fold; keep the two in
+    sync on any numerics change.
+
+    ``s`` must already be masked to ``NEG_INF`` off-``keep``; the ``* keep``
+    below makes masked entries contribute exactly 0 even in an all-masked
+    tile (where s == m_new == NEG_INF would make exp() == 1).
+    """
+    m_prev = m_scr[:, :1]                                 # [bq, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new) * keep                         # [bq, bk]
+    corr = jnp.exp(m_prev - m_new)                        # [bq, 1]
+    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0, 0],          # bf16 MXU, f32 accumulate
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, scale: float, n_k: int):
-    # Streaming-softmax update mirrored in agent_tpu.parallel.ring (fold) —
-    # keep the two in sync on any numerics change.
     kb = pl.program_id(3)
 
     @pl.when(kb == 0)
@@ -92,20 +114,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
     ) * scale
     keep = mask_ref[0, 0, :][None, :] > 0                 # [1, bk]
     s = jnp.where(keep, s, NEG_INF)
-
-    m_prev = m_scr[:, :1]                                 # [bq, 1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    # Masked entries contribute exactly 0 even in an all-masked tile (where
-    # s == m_new == NEG_INF would make exp() == 1).
-    p = jnp.exp(s - m_new) * keep                         # [bq, bk]
-    corr = jnp.exp(m_prev - m_new)                        # [bq, 1]
-    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0, 0],          # bf16 MXU, f32 accumulate
-        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-    )
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+    _tile_softmax_update(s, keep, v_ref, m_scr, l_scr, acc_scr)
 
     @pl.when(kb == n_k - 1)
     def _emit():
@@ -219,17 +228,7 @@ def _flash_fold_kernel(q_ref, k_ref, v_ref, mask_ref,
     ) * scale
     keep = mask_ref[0, 0, :][None, :] > 0
     s = jnp.where(keep, s, NEG_INF)
-    m_prev = m_scr[:, :1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new) * keep
-    corr = jnp.exp(m_prev - m_new)
-    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0, 0],
-        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-    )
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+    _tile_softmax_update(s, keep, v_ref, m_scr, l_scr, acc_scr)
 
     @pl.when(kb == n_k - 1)
     def _emit():
@@ -352,18 +351,7 @@ def _flash_t5_kernel(q_ref, k_ref, v_ref, mask_ref, bias_ref, o_ref,
     ) * scale + bias
     keep = mask_ref[0, 0, :][None, :] > 0
     s = jnp.where(keep, s, NEG_INF)
-
-    m_prev = m_scr[:, :1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new) * keep
-    corr = jnp.exp(m_prev - m_new)
-    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0, 0],
-        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-    )
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+    _tile_softmax_update(s, keep, v_ref, m_scr, l_scr, acc_scr)
 
     @pl.when(kb == n_k - 1)
     def _emit():
@@ -573,17 +561,7 @@ def _flash_fwd_lse_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
     ) * scale
     keep = mask_ref[0, 0, :][None, :] > 0
     s = jnp.where(keep, s, NEG_INF)
-    m_prev = m_scr[:, :1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new) * keep
-    corr = jnp.exp(m_prev - m_new)
-    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0, 0],
-        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-    )
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+    _tile_softmax_update(s, keep, v_ref, m_scr, l_scr, acc_scr)
 
     @pl.when(kb == n_k - 1)
     def _emit():
@@ -880,77 +858,25 @@ def _trainable_core(block_q: int, block_k: int, interpret: bool,
     return attn
 
 
-def make_flash_attention_trainable(mesh):
-    """Mesh-aware trainable flash attention — :func:`make_flash_attention`
-    for the training path. Batch shards over ``dp``, heads over ``tp``;
-    ``shard_map`` differentiates through the per-shard ``custom_vjp``, so
-    the backward kernels also run sharded. Unsupported shapes fall back to
-    the dense path (GSPMD + autodiff handle it)."""
-    if mesh.size == 1:
-        return flash_attention_trainable
+def _make_mesh_wrapper(mesh, inner, dense_counter_key: Optional[str]):
+    """ONE shard_map wrapper for both flash kernels (batch over ``dp``,
+    heads over ``tp``) — inference and trainable share the sharding layout,
+    the divisibility gate, and the mask materialization, so a future spec
+    change cannot silently diverge the two paths.
 
-    from jax.sharding import PartitionSpec as P
-
-    shape = dict(mesh.shape)
-    dp = shape.get("dp", 1)
-    tp = shape.get("tp", 1)
-
-    sharded = jax.shard_map(
-        flash_attention_trainable,
-        mesh=mesh,
-        in_specs=(
-            P("dp", "tp", None, None),
-            P("dp", "tp", None, None),
-            P("dp", "tp", None, None),
-            P("dp", None, None, None),
-        ),
-        out_specs=P("dp", "tp", None, None),
-        check_vma=False,
-    )
-
-    def mesh_flash_attention_trainable(q, k, v, mask):
-        from agent_tpu.models.layers import (
-            is_key_padding_mask,
-            materialize_key_padding_mask,
-        )
-
-        B, H, _, _ = q.shape
-        Lk = k.shape[2]
-        ok = is_key_padding_mask(mask, B, Lk) and B % dp == 0 and H % tp == 0
-        if not ok:
-            # Tick the counter here too: inside shard_map the per-shard call
-            # ticks, but this wrapper-level fallback would otherwise be
-            # invisible to the trace-time selection proof (one tick per
-            # compiled program, whichever level decided).
-            SELECTION_COUNTS["dense_train"] = (
-                SELECTION_COUNTS.get("dense_train", 0) + 1
-            )
-            return dot_product_attention(q, k, v, mask)
-        return sharded(q, k, v, materialize_key_padding_mask(mask, B, Lk))
-
-    return mesh_flash_attention_trainable
-
-
-def make_flash_attention(mesh):
-    """Mesh-aware flash attention: the kernel wrapped in ``shard_map``.
-
-    ``pallas_call`` has no GSPMD partitioning rule, so jitting the bare kernel
-    over a dp/tp mesh silently all-gathers the batch and runs the full-batch
-    kernel replicated on every chip. Wrapping in ``shard_map`` (batch over
-    ``dp``, heads over ``tp``) keeps each chip on its own shard. Single-device
-    meshes skip the wrapper. Shapes the wrapper can't shard (batch or heads
-    indivisible) fall back to the dense XLA path, which GSPMD partitions fine.
+    ``dense_counter_key`` ticks ``SELECTION_COUNTS`` when the WRAPPER (not
+    the per-shard kernel) decides on the dense fallback: inside shard_map
+    the per-shard call ticks its own counter, but a wrapper-level decline
+    would otherwise be invisible to the trace-time selection proof.
     """
+    from jax.sharding import PartitionSpec as P
+
     shape = dict(mesh.shape)
     dp = shape.get("dp", 1)
     tp = shape.get("tp", 1)
-    if mesh.size == 1:
-        return flash_attention
-
-    from jax.sharding import PartitionSpec as P
 
     sharded = jax.shard_map(
-        flash_attention,
+        inner,
         mesh=mesh,
         in_specs=(
             P("dp", "tp", None, None),
@@ -965,7 +891,7 @@ def make_flash_attention(mesh):
         check_vma=False,
     )
 
-    def mesh_flash_attention(q, k, v, mask):
+    def mesh_attention(q, k, v, mask):
         from agent_tpu.models.layers import (
             is_key_padding_mask,
             materialize_key_padding_mask,
@@ -975,7 +901,39 @@ def make_flash_attention(mesh):
         Lk = k.shape[2]
         ok = is_key_padding_mask(mask, B, Lk) and B % dp == 0 and H % tp == 0
         if not ok:
+            if dense_counter_key is not None:
+                SELECTION_COUNTS[dense_counter_key] = (
+                    SELECTION_COUNTS.get(dense_counter_key, 0) + 1
+                )
             return dot_product_attention(q, k, v, mask)
         return sharded(q, k, v, materialize_key_padding_mask(mask, B, Lk))
 
-    return mesh_flash_attention
+    return mesh_attention
+
+
+def make_flash_attention_trainable(mesh):
+    """Mesh-aware trainable flash attention — :func:`make_flash_attention`
+    for the training path. Batch shards over ``dp``, heads over ``tp``;
+    ``shard_map`` differentiates through the per-shard ``custom_vjp``, so
+    the backward kernels also run sharded. Unsupported shapes fall back to
+    the dense path (GSPMD + autodiff handle it)."""
+    if mesh.size == 1:
+        return flash_attention_trainable
+    return _make_mesh_wrapper(mesh, flash_attention_trainable, "dense_train")
+
+
+def make_flash_attention(mesh):
+    """Mesh-aware flash attention: the kernel wrapped in ``shard_map``.
+
+    ``pallas_call`` has no GSPMD partitioning rule, so jitting the bare kernel
+    over a dp/tp mesh silently all-gathers the batch and runs the full-batch
+    kernel replicated on every chip. Wrapping in ``shard_map`` (batch over
+    ``dp``, heads over ``tp``) keeps each chip on its own shard. Single-device
+    meshes skip the wrapper. Shapes the wrapper can't shard (batch or heads
+    indivisible) fall back to the dense XLA path, which GSPMD partitions fine.
+    """
+    if mesh.size == 1:
+        return flash_attention
+    # No counter key: the wrapper-level dense fallback predates the proof
+    # discipline and tests pin the "dense" counter to per-kernel decisions.
+    return _make_mesh_wrapper(mesh, flash_attention, None)

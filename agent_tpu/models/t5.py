@@ -416,14 +416,20 @@ def generate(
     cfg: T5Config,
     max_new_tokens: int,
     num_beams: int = 1,
+    kernel=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy (or beam) generation via the shared scan engines. Returns
-    (tokens [B, T], lengths [B]); tokens after EOS are the pad id."""
+    (tokens [B, T], lengths [B]); tokens after EOS are the pad id.
+
+    ``kernel`` routes the encoder pass through a fused T5 attention kernel
+    (see :func:`encode` — pass ``runtime.t5_attention_kernel()`` for the
+    mesh-aware wrapper); the decoder's incremental steps keep the dense
+    bias path (per-step Lq == 1 is outside the kernel's contract)."""
     from agent_tpu.models.decoding import beam_scan, greedy_scan
 
     B = src_ids.shape[0]
     T = max_new_tokens
-    enc_out = encode(params, src_ids, src_mask, cfg)
+    enc_out = encode(params, src_ids, src_mask, cfg, kernel=kernel)
     pos = jnp.arange(T, dtype=jnp.int32)
     causal = jnp.where(
         pos[None, :] <= pos[:, None], 0.0, NEG_INF

@@ -216,13 +216,15 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                 from agent_tpu.models import t5
 
                 # No generic attn_fn: T5's bias-carrying attention has its
-                # own fused path — t5.encode routes long-context self-
-                # attention through the dedicated Pallas kernel
-                # (flash_attention_t5, bias computed per tile in VMEM) and
+                # own fused path — the runtime's mesh-aware kernel wrapper
+                # (make_flash_attention_t5: batch over dp, heads over tp;
+                # bias computed per tile in VMEM) goes to t5.encode, which
                 # falls back to dense for short/unsupported shapes. Ring-
                 # over-sp composition remains a known limitation.
+                t5_kernel = runtime.t5_attention_kernel()
                 gen = lambda p, i, m: t5.generate(  # noqa: E731
                     p, i, m, cfg, max_new, num_beams=num_beams,
+                    kernel=t5_kernel,
                 )
             else:
                 gen = (

@@ -84,6 +84,8 @@ class TpuRuntime:
         self._params_lock = threading.Lock()
         self._attention_fn = None
         self._train_attention_fn = None
+        self._t5_kernel = None
+        self._t5_kernel_built = False
         self.compute_dtype = self.config.compute_dtype
 
     # ---- topology ----
@@ -161,6 +163,28 @@ class TpuRuntime:
 
                 self._train_attention_fn = dot_product_attention
         return self._train_attention_fn
+
+    def t5_attention_kernel(self):
+        """The fused T5 bias-attention kernel for this mesh, or ``None``.
+
+        T5's encoder self-attention carries a bucketed relative-position
+        bias, so it cannot ride the generic :meth:`attention_fn`; it has its
+        own Pallas kernel (``kernels.flash_attention_t5``, bias computed per
+        tile in VMEM) and mesh wrapper (``make_flash_attention_t5`` — batch
+        over dp, heads over tp). Same platform gate as the generic kernel.
+        ``None`` means "dense path" (``t5.encode`` builds the dense bias
+        lazily); the kernel itself also declines unsupported shapes at
+        trace time, ticking the ``t5_dense`` selection counter.
+        """
+        if not self._t5_kernel_built:
+            self._t5_kernel_built = True
+            if self.platform == "tpu" and self.config.pallas_attn:
+                from agent_tpu.kernels.flash_attention import (
+                    make_flash_attention_t5,
+                )
+
+                self._t5_kernel = make_flash_attention_t5(self.mesh)
+        return self._t5_kernel
 
     def replicated(self) -> NamedSharding:
         return self.sharding()
