@@ -43,6 +43,16 @@ class EncoderConfig:
     # TPU-native successor of the reference's INT8 TFLite execution
     # (reference ops/_tpu_runtime.py:23-31).
     quant: str = "none"
+    # Serving-strategy fields (payload model_config may set them, SURVEY
+    # §2.8 "strategies usable by the workload"):
+    # pp > 1 pipelines the block stack over a ``pp`` mesh axis
+    # (parallel.pipeline.encoder_forward_pp); n_layers must divide by pp.
+    pp: int = 1
+    # moe_experts > 0 replaces each block's dense FFN with a Switch MoE
+    # layer (models.moe) — experts shard over an ``ep`` mesh axis when the
+    # serving mesh has one, else run unsharded.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @property
     def compute_dtype(self):
@@ -52,19 +62,46 @@ class EncoderConfig:
         return replace(self, **overrides)
 
 
+def moe_cfg_of(cfg: EncoderConfig):
+    """The block-level MoE config for an ``moe_experts > 0`` encoder."""
+    from agent_tpu.models.moe import MoeConfig
+
+    return MoeConfig(
+        d_model=cfg.d_model, d_ff=cfg.d_ff, n_experts=cfg.moe_experts,
+        capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+    )
+
+
 def init_params(cfg: EncoderConfig, model_id: str = "classify-default") -> Params:
-    """Deterministic param pytree for ``model_id`` (same id ⇒ same weights)."""
+    """Deterministic param pytree for ``model_id`` (same id ⇒ same weights).
+
+    ``moe_experts > 0``: each block's dense ``ffn`` subtree is replaced by a
+    ``moe`` subtree (router + expert-stacked FFN, ``models.moe``); attention
+    and norms are unchanged, so the MoE encoder serves through the same
+    forward and op contract.
+    """
     key = layers.seed_from(model_id)
     ks = jax.random.split(key, cfg.n_layers + 3)
+    blocks = [
+        layers.init_block(ks[i + 1], cfg.d_model, cfg.n_heads, cfg.d_ff)
+        for i in range(cfg.n_layers)
+    ]
+    if cfg.moe_experts > 0:
+        from agent_tpu.models import moe
+
+        mcfg = moe_cfg_of(cfg)
+        for i, blk in enumerate(blocks):
+            del blk["ffn"]
+            # Fold the layer index into the key so experts differ per layer.
+            blk["moe"] = moe.init_moe_ffn(
+                jax.random.fold_in(ks[i + 1], 0x40E), mcfg
+            )
     params: Params = {
         "embed": jax.random.normal(
             ks[0], (cfg.vocab_size, cfg.d_model), dtype=jnp.float32
         ) * 0.02,
         "pos": jnp.asarray(layers.sinusoidal_positions(cfg.max_len, cfg.d_model)),
-        "blocks": [
-            layers.init_block(ks[i + 1], cfg.d_model, cfg.n_heads, cfg.d_ff)
-            for i in range(cfg.n_layers)
-        ],
+        "blocks": blocks,
         "ln_f": layers.init_layer_norm(cfg.d_model),
         "head": layers.init_dense(ks[-1], cfg.d_model, cfg.n_classes),
     }
@@ -83,6 +120,7 @@ def forward(
     cfg: EncoderConfig,
     attn_fn=layers.dot_product_attention,
     remat: bool = False,
+    mesh=None,
 ) -> jax.Array:
     """Logits [B, n_classes] (f32). Mean-pool over real tokens, linear head.
 
@@ -90,17 +128,31 @@ def forward(
     pass recomputes block activations instead of storing them — at training
     scale the stored [B, H, L, L] attention scores otherwise exceed HBM
     (BERT-base, batch 256, seq 512: ~39 GB saved for ~33% more FLOPs).
+
+    ``mesh`` matters only for MoE configs (``moe_experts > 0``): when it
+    carries an ``ep`` axis the expert batches get explicit sharding
+    constraints so the experts provably land on ``ep``.
     """
     dtype = cfg.compute_dtype
     L = ids.shape[1]
     x = params["embed"].astype(dtype)[ids] + params["pos"][:L].astype(dtype)[None]
     attn_mask = layers.pad_mask_to_attn(mask)
+    moe_ctx = None
+    if cfg.moe_experts > 0:
+        moe_ctx = (
+            moe_cfg_of(cfg),
+            mesh if mesh is not None and "ep" in mesh.shape else None,
+        )
     block_fn = (
         jax.checkpoint(
-            lambda p, h, m: layers.encoder_block(p, h, m, dtype, attn_fn=attn_fn)
+            lambda p, h, m: layers.encoder_block(
+                p, h, m, dtype, attn_fn=attn_fn, moe_ctx=moe_ctx
+            )
         )
         if remat
-        else (lambda p, h, m: layers.encoder_block(p, h, m, dtype, attn_fn=attn_fn))
+        else (lambda p, h, m: layers.encoder_block(
+            p, h, m, dtype, attn_fn=attn_fn, moe_ctx=moe_ctx
+        ))
     )
     for block in params["blocks"]:
         x = block_fn(block, x, attn_mask)

@@ -207,13 +207,28 @@ def init_block(key: jax.Array, d_model: int, n_heads: int, d_ff: int,
 
 def encoder_block(
     p: Params, x: jax.Array, mask: jax.Array, dtype: Any,
-    attn_fn=dot_product_attention,
+    attn_fn=dot_product_attention, moe_ctx=None,
 ) -> jax.Array:
-    """Pre-LN transformer block: x + Attn(LN(x)); x + FFN(LN(x))."""
+    """Pre-LN transformer block: x + Attn(LN(x)); x + FFN(LN(x)).
+
+    A block carrying a ``moe`` subtree (``encoder.init_params`` with
+    ``moe_experts > 0``) routes its FFN sublayer through the Switch MoE
+    layer; ``moe_ctx`` is the ``(MoeConfig, mesh-or-None)`` pair the caller
+    (``encoder.forward``) resolved once for the whole stack.
+    """
     h = layer_norm(p["ln1"], x)
     a, _ = attention(p["attn"], h, h, mask, dtype, attn_fn=attn_fn)
     x = x + a
     h = layer_norm(p["ln2"], x)
+    if "moe" in p:
+        from agent_tpu.models import moe as moe_mod
+
+        mcfg, mesh = moe_ctx
+        B, L, d = h.shape
+        y, _aux = moe_mod.moe_ffn(
+            p["moe"], h.astype(dtype).reshape(B * L, d), mcfg, mesh=mesh
+        )
+        return x + y.reshape(B, L, d).astype(x.dtype)
     return x + ffn(p["ffn"], h, dtype)
 
 

@@ -162,19 +162,27 @@ def iter_chunks(seqs: Sequence, max_chunk: int) -> Iterator[Sequence]:
         yield seqs[i : i + max_chunk]
 
 
+def resolve_runtime(ctx):
+    """The runtime this op will execute on, or ``None`` when no backend is
+    available. A host-side metadata read — never initializes device state
+    beyond what the runtime singleton already did."""
+    try:
+        if ctx is not None and getattr(ctx, "require_runtime", None):
+            return ctx.require_runtime()
+        from agent_tpu.runtime.runtime import get_runtime
+
+        return get_runtime()
+    except Exception:  # noqa: BLE001 — no backend
+        return None
+
+
 def resolve_dp(ctx) -> int:
     """The mesh ``dp`` extent the op's batches must divide — a host-side
     metadata read. The pipeline always injects a built runtime; standalone
     calls resolve the singleton here, on the owning thread. No backend at
     all ⇒ 1, matching the degraded CPU path's shapes."""
-    try:
-        if ctx is not None and getattr(ctx, "require_runtime", None):
-            return ctx.require_runtime().axis_size("dp")
-        from agent_tpu.runtime.runtime import get_runtime
-
-        return get_runtime().axis_size("dp")
-    except Exception:  # noqa: BLE001 — no backend ⇒ dp=1 shapes
-        return 1
+    rt = resolve_runtime(ctx)
+    return rt.axis_size("dp") if rt is not None else 1
 
 
 def length_buckets_for(max_len: int) -> List[int]:

@@ -281,6 +281,44 @@ def _execute_chunks(
         specs=specs,
     )
     attn_fn = runtime.attention_fn()  # ring over sp when the mesh has one
+
+    # Pipeline-parallel routing (SURVEY §2.8 "strategies usable by the
+    # workload"): a pp axis on the serving mesh, or model_config {"pp": N},
+    # sends the encoder's block stack through the GPipe shard_map schedule.
+    # With a derived mesh (same devices, dp×pp layout) XLA reshards the
+    # dp-placed inputs at the jit boundary; workers that serve pp-heavy
+    # models full-time should put the pp axis in MESH_SHAPE instead.
+    pp_mesh = None
+    if family == "encoder":
+        if runtime.axis_size("pp") > 1:
+            pp_mesh = runtime.mesh
+        elif getattr(cfg, "pp", 1) > 1:
+            from agent_tpu.runtime.mesh import build_mesh
+
+            pp = cfg.pp
+            n_dev = runtime.n_devices
+            if n_dev % pp != 0:
+                raise ValueError(
+                    f"pp={pp} does not divide the {n_dev}-device mesh"
+                )
+            pp_mesh = build_mesh(
+                runtime.devices, {"dp": n_dev // pp, "pp": pp}
+            )
+    if pp_mesh is not None:
+        from agent_tpu.parallel.pipeline import encoder_forward_pp
+
+        # Inside the pp shard_map the per-stage attention must be a plain
+        # per-shard function (a nested mesh wrapper would shard_map twice):
+        # the bare flash kernel on TPU, dense elsewhere.
+        if runtime.platform == "tpu" and runtime.config.pallas_attn:
+            from agent_tpu.kernels.flash_attention import (
+                flash_attention as pp_attn,
+            )
+        else:
+            from agent_tpu.models.layers import (
+                dot_product_attention as pp_attn,
+            )
+
     pending: List[Tuple[Any, Any, int]] = []
     for ids, lengths, n in chunks:
         B, L = ids.shape
@@ -288,9 +326,20 @@ def _execute_chunks(
         def build(L=L):
             def run_fwd(p, i, nlen):
                 mask = (jnp.arange(L)[None, :] < nlen[:, None]).astype(jnp.int32)
-                logits = model_mod.forward(
-                    p, i.astype(jnp.int32), mask, cfg, attn_fn=attn_fn
-                )
+                if pp_mesh is not None:
+                    logits = encoder_forward_pp(
+                        p, i.astype(jnp.int32), mask, cfg, pp_mesh,
+                        attn_fn=pp_attn,
+                    )
+                elif family == "encoder":
+                    logits = model_mod.forward(
+                        p, i.astype(jnp.int32), mask, cfg, attn_fn=attn_fn,
+                        mesh=runtime.mesh,  # ep expert sharding for MoE cfgs
+                    )
+                else:
+                    logits = model_mod.forward(
+                        p, i.astype(jnp.int32), mask, cfg, attn_fn=attn_fn
+                    )
                 return encoder.topk_probs(logits, k)
 
             return jax.jit(run_fwd)
@@ -404,6 +453,9 @@ def stage(payload: Any, ctx: Optional[object] = None):
 
     model_id = _resolve_model_id(payload)
     family = _resolve_family(model_id)
+    from agent_tpu.ops._model_common import resolve_runtime
+
+    rt = resolve_runtime(ctx)  # one resolution serves guards and staging
     try:
         # Checkpoint-integrity problems (unreadable config.json, missing
         # vocab) raise past this handler on purpose: they fail the shard for
@@ -415,6 +467,35 @@ def stage(payload: Any, ctx: Optional[object] = None):
         from agent_tpu.ops._model_common import apply_quant_env
 
         cfg = apply_quant_env(payload, cfg)
+        if family == "encoder":
+            # Strategy-combination guards (caller error → soft bad_input):
+            # pp stages the stacked block pytree and MoE/int8 reshape its
+            # leaves — the unsupported pairings must reject, not mis-serve.
+            # The EFFECTIVE pp is the mesh's pp axis when the serving mesh
+            # has one (execute routes through the pipeline for it with no
+            # payload involvement), else model_config's pp — guarding only
+            # cfg.pp would let the mesh-axis route bypass every check.
+            mesh_pp = rt.axis_size("pp") if rt is not None else 1
+            eff_pp = mesh_pp if mesh_pp > 1 else getattr(cfg, "pp", 1)
+            if eff_pp > 1:
+                if cfg.n_layers % eff_pp != 0:
+                    raise ValueError(
+                        f"n_layers {cfg.n_layers} not divisible by pp={eff_pp}"
+                    )
+                if cfg.quant == "int8":
+                    raise ValueError("pp serving does not support quant=int8")
+                if cfg.moe_experts > 0:
+                    raise ValueError(
+                        "pp and moe_experts cannot combine in one config"
+                    )
+                if mesh_pp <= 1 and rt is not None \
+                        and rt.n_devices % eff_pp != 0:
+                    raise ValueError(
+                        f"pp={eff_pp} does not divide the "
+                        f"{rt.n_devices}-device mesh"
+                    )
+            if cfg.moe_experts > 0 and cfg.quant == "int8":
+                raise ValueError("MoE serving does not support quant=int8")
         items, kind, single = _collect_sequences(payload, cfg)
         from agent_tpu.ops._model_common import (
             validate_output_uri,
@@ -426,11 +507,18 @@ def stage(payload: Any, ctx: Optional[object] = None):
     except ValueError as exc:
         return "done", bad_input(str(exc))
 
-    # Batch buckets must divide the mesh that will execute them.
-    from agent_tpu.ops._model_common import resolve_dp
-
+    # Batch buckets must divide the mesh that will execute them. The pp
+    # schedule additionally needs batches divisible by n_micro × pipeline-dp
+    # (= pp·dp on a pp mesh; = all devices for a derived mesh), so pp
+    # configs stage with that larger divisor.
+    dp_stage = rt.axis_size("dp") if rt is not None else 1
+    if family == "encoder" and rt is not None:
+        if rt.axis_size("pp") > 1:
+            dp_stage = rt.axis_size("pp") * rt.axis_size("dp")
+        elif getattr(cfg, "pp", 1) > 1:
+            dp_stage = rt.n_devices
     chunks = _stage_chunks(
-        resolve_dp(ctx), items, kind, cfg, family=family, model_id=model_id
+        dp_stage, items, kind, cfg, family=family, model_id=model_id
     )
 
     state = {

@@ -64,12 +64,31 @@ def _block_specs(cross: bool = False) -> Params:
     return p
 
 
+def _moe_block_specs() -> Params:
+    """Block with a Switch MoE FFN: experts over ``ep``, router replicated
+    (``models.moe.moe_param_specs`` layout inside the encoder block)."""
+    return {
+        "ln1": _ln_specs(),
+        "attn": _attn_specs(),
+        "ln2": _ln_specs(),
+        "moe": {
+            "router": {"w": P()},
+            "wi": P("ep", None, None),
+            "wo": P("ep", None, None),
+        },
+    }
+
+
 def encoder_param_specs(cfg) -> Params:
     """PartitionSpec pytree matching ``models.encoder.init_params(cfg)``."""
+    moe = getattr(cfg, "moe_experts", 0) > 0
     return {
         "embed": P("tp", None),
         "pos": P(),
-        "blocks": [_block_specs() for _ in range(cfg.n_layers)],
+        "blocks": [
+            _moe_block_specs() if moe else _block_specs()
+            for _ in range(cfg.n_layers)
+        ],
         "ln_f": _ln_specs(),
         "head": _dense_specs(col=True),
     }
@@ -222,10 +241,22 @@ def sanitize_specs(mesh, params: Any, specs: Any) -> Any:
     replicated rather than failing the op.
     """
 
+    def drop_missing(entry):
+        # Axis names the mesh doesn't have (e.g. "ep" on a dp/tp mesh)
+        # would make NamedSharding raise; such entries replicate instead.
+        if entry is None:
+            return None
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(n for n in names if n in mesh.shape)
+        if not kept:
+            return None
+        return kept if isinstance(entry, tuple) else kept[0]
+
     def fix(leaf, spec):
         shape = getattr(leaf, "shape", ())
         if len(spec) > len(shape):
             return P()
+        spec = P(*(drop_missing(e) for e in spec))
         for dim, entry in zip(shape, spec):
             if dim % _axes_size(mesh, entry) != 0:
                 return P()

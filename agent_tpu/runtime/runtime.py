@@ -212,7 +212,12 @@ class TpuRuntime:
         per-key-event cache as executables, so concurrent first callers
         trigger exactly one build / one HBM transfer.
         """
-        use_specs = specs is not None and self.axis_size("tp") > 1
+        # Any model-parallel axis (tp for dense Megatron sharding, ep for
+        # MoE expert sharding) activates spec placement; sanitize_specs
+        # strips axes the mesh doesn't carry.
+        use_specs = specs is not None and (
+            self.axis_size("tp") > 1 or self.axis_size("ep") > 1
+        )
 
         def place() -> Any:
             host = build()

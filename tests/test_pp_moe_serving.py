@@ -1,0 +1,133 @@
+"""PP and MoE must be SERVABLE through the op contract, not just provable
+in a harness (SURVEY §2.8 "strategies usable by the workload"; VERDICT r3
+ask #5): ``model_config: {"pp": 2}`` routes ``map_classify_tpu`` through the
+GPipe shard_map schedule, a pp axis on the serving mesh does the same with
+no payload change, and ``model_config: {"moe_experts": N}`` serves a Switch
+MoE encoder whose experts shard over an ``ep`` mesh axis when present.
+Every strategy's outputs must match the plain dense/unsharded forward.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from agent_tpu.config import DeviceConfig
+from agent_tpu.ops import get_op
+from agent_tpu.runtime.context import OpContext
+from agent_tpu.runtime.runtime import TpuRuntime, get_runtime
+
+BASE_CONFIG = {
+    "vocab_size": 260, "d_model": 32, "n_heads": 4, "n_layers": 2,
+    "d_ff": 64, "max_len": 64, "n_classes": 16, "dtype": "float32",
+}
+TEXTS = ["strategy serving row %d" % i for i in range(16)]
+
+
+def _classify(runtime, model_config):
+    out = get_op("map_classify_tpu")(
+        {
+            "texts": TEXTS,
+            "topk": 3,
+            "allow_fallback": False,
+            "result_format": "columnar",
+            "model_config": model_config,
+        },
+        OpContext(runtime=runtime),
+    )
+    assert out["ok"] is True, out
+    return np.asarray(out["indices"]), np.asarray(out["scores"])
+
+
+def _mesh_runtime(shape):
+    return TpuRuntime(
+        config=DeviceConfig(mesh_shape=shape), devices=jax.devices()[:8]
+    )
+
+
+def test_pp_via_model_config_matches_dense():
+    """{"pp": 2} on the default (no-pp-axis) mesh: the op derives a dp×pp
+    mesh over the same devices and the results equal the pp=1 serve."""
+    rt = get_runtime()
+    want_idx, want_scores = _classify(rt, BASE_CONFIG)
+    got_idx, got_scores = _classify(rt, {**BASE_CONFIG, "pp": 2})
+    np.testing.assert_array_equal(got_idx, want_idx)
+    np.testing.assert_allclose(got_scores, want_scores, atol=1e-5)
+
+
+def test_pp_via_mesh_axis_matches_dense():
+    """A pp axis on the serving mesh routes through the pipeline with NO
+    payload change — the mesh is the config (scaling-book recipe)."""
+    rt_pp = _mesh_runtime({"dp": 4, "pp": 2})
+    assert rt_pp.axis_size("pp") == 2
+    want_idx, want_scores = _classify(get_runtime(), BASE_CONFIG)
+    got_idx, got_scores = _classify(rt_pp, BASE_CONFIG)
+    np.testing.assert_array_equal(got_idx, want_idx)
+    np.testing.assert_allclose(got_scores, want_scores, atol=1e-5)
+
+
+def test_moe_serves_and_ep_sharding_matches_unsharded():
+    """moe_experts=4 serves through the op; an ep=4 mesh (experts sharded,
+    all-to-all at dispatch/combine) returns the same results as the
+    unsharded MoE on the default mesh."""
+    moe_config = {**BASE_CONFIG, "moe_experts": 4}
+    want_idx, want_scores = _classify(get_runtime(), moe_config)
+    rt_ep = _mesh_runtime({"dp": 2, "ep": 4})
+    got_idx, got_scores = _classify(rt_ep, moe_config)
+    np.testing.assert_array_equal(got_idx, want_idx)
+    np.testing.assert_allclose(got_scores, want_scores, atol=1e-5)
+
+
+def test_moe_output_differs_from_dense_ffn():
+    """The MoE path must actually run experts — not silently fall back to
+    the dense FFN (same seed would then give identical logits)."""
+    rt = get_runtime()
+    dense_idx, dense_scores = _classify(rt, BASE_CONFIG)
+    moe_idx, moe_scores = _classify(rt, {**BASE_CONFIG, "moe_experts": 4})
+    assert not (
+        np.array_equal(moe_idx, dense_idx)
+        and np.allclose(moe_scores, dense_scores)
+    ), "MoE config produced bit-identical results to the dense FFN"
+
+
+@pytest.mark.parametrize(
+    "bad_config, msg",
+    [
+        ({"pp": 2, "n_layers": 3}, "not divisible"),
+        ({"pp": 2, "quant": "int8"}, "quant=int8"),
+        ({"pp": 2, "moe_experts": 4}, "cannot combine"),
+        ({"moe_experts": 4, "quant": "int8"}, "quant=int8"),
+    ],
+)
+def test_unsupported_strategy_combinations_reject_softly(bad_config, msg):
+    out = get_op("map_classify_tpu")(
+        {
+            "texts": ["x"],
+            "model_config": {**BASE_CONFIG, **bad_config},
+        },
+        OpContext(runtime=get_runtime()),
+    )
+    assert out["ok"] is False and msg in out["error"], out
+
+
+@pytest.mark.parametrize(
+    "bad_config, msg",
+    [
+        ({"moe_experts": 4}, "cannot combine"),
+        ({"quant": "int8"}, "quant=int8"),
+        ({"n_layers": 3}, "not divisible"),
+    ],
+)
+def test_mesh_pp_axis_route_enforces_same_guards(bad_config, msg):
+    """The mesh-axis pp route (no payload pp at all) must hit the SAME
+    strategy guards as model_config {"pp": N} — a pp-mesh worker receiving
+    an MoE/int8/odd-depth config must soft-reject, not crash in the jit."""
+    rt_pp = _mesh_runtime({"dp": 4, "pp": 2})
+    out = get_op("map_classify_tpu")(
+        {
+            "texts": ["x"],
+            "model_config": {**BASE_CONFIG, **bad_config},
+        },
+        OpContext(runtime=rt_pp),
+    )
+    assert out["ok"] is False and msg in out["error"], out
