@@ -38,13 +38,18 @@ _LANES = 128  # VPU lane width; scratch last dims pad to this anyway
 # Below this key length the XLA dense path wins END TO END. Attention-only
 # microbenchmarks on v5e show the kernel ahead already at Lk=512/d_head 64
 # (1.25-1.4×), but inside the full encoder the gate at 512 measured ~13%
-# SLOWER at BERT-base scale (804 vs 929 rows/s): pallas_call is a fusion
-# barrier — XLA can no longer fuse the projection matmuls/softmax chain
-# around attention — and the [B,L,H,D]→grid layout transitions eat the
-# kernel's margin. The win is real only once the dense path's [Lq, Lk]
-# score materialization dominates: 3.7× at 4k/d_head 128, >50× at 8k where
-# dense thrashes HBM (450 ms/call vs 8.5 ms). Hence the 2048 gate; trust
-# model-level numbers over kernel microbenchmarks when moving it.
+# SLOWER at BERT-base scale: pallas_call is a fusion barrier — XLA can no
+# longer fuse the projection matmuls/softmax chain around attention — and
+# the [B,L,H,D]→grid layout transitions eat the kernel's margin. The win
+# is real once the dense path's [Lq, Lk] score materialization dominates.
+# Measured per-call ratios vs the CURRENT dense path (which stores scores
+# in bf16 — that change roughly doubled dense speed and honestly shrank
+# these ratios from the old f32-score era's 3.7×/50×): 1.33× at 4k,
+# 1.94× at 8k, d_head 128 (driver artifact `flash_vs_dense[_8k]`,
+# BENCH_r04). The kernel's bigger win at long context is MEMORY — no
+# [L, L] score tensor in HBM, so batch/length scale past where dense
+# OOMs. Hence the 2048 gate; trust model-level numbers over kernel
+# microbenchmarks when moving it.
 FLASH_MIN_KEY_LEN = 2048
 
 # Trace-time selection tally: ``flash_attention`` decides kernel-vs-dense while
@@ -141,10 +146,11 @@ def flash_attention(
     kernel is testable on the CPU mesh; pass False to require Mosaic.
 
     Default 512×512 tiles measured best on v5e (scores tile = 1 MB VMEM).
-    Measured v5e per-call ratios vs the dense XLA path (which materializes
-    the [Lq, Lk] scores in HBM): 3.7× at 4k context, >50× at 8k, at
-    d_head 128 — see ``FLASH_MIN_KEY_LEN`` note and ``bench.py``'s
-    ``long_ctx`` leg, which records the ratio as a driver artifact.
+    Measured v5e per-call ratios vs the dense XLA path: 1.33× at 4k
+    context, 1.94× at 8k, at d_head 128 — see the ``FLASH_MIN_KEY_LEN``
+    note (incl. why these shrank when dense went bf16-score) and
+    ``bench.py``'s ``long_ctx`` leg, which records both as driver
+    artifacts (``flash_vs_dense_speedup``, ``flash_vs_dense_8k``).
     """
     from agent_tpu.models.layers import is_key_padding_mask
 

@@ -34,19 +34,28 @@ def greedy_scan(
     pad_id: int = 0,
     forced_first_id: Optional[int] = None,
     forced_last_id: Optional[int] = None,
+    early_exit: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy decode → (tokens [B, T], lengths [B]).
 
     Rows emit ``pad_id`` after their EOS; ``forced_first_id`` (e.g. BART's
     ``forced_bos_token_id``) overrides the step-0 argmax, and
     ``forced_last_id`` (``forced_eos_token_id``) the final step's, when set.
+
+    ``early_exit=True`` (default) runs the decode as a ``lax.while_loop``
+    that stops once EVERY row has emitted EOS — identical outputs (the
+    untouched tail of the token buffer is already ``pad_id``, exactly what
+    the full-length scan would write), but a batch of short summaries pays
+    for its longest row, not for ``max_new_tokens``. ``False`` keeps the
+    fixed-trip ``lax.scan`` (marginally better for batches that always run
+    full length, and the differentiable choice if a scoring path ever
+    backprops through decode — ``while_loop`` has no reverse rule).
     """
     bos = jnp.full((batch,), start_id, dtype=jnp.int32)
     done0 = jnp.zeros((batch,), dtype=jnp.bool_)
     last = max_new_tokens - 1
 
-    def body(carry, step):
-        tok, done, caches = carry
+    def step_tok(tok, done, caches, step):
         logits, caches = step_fn(tok, step, caches)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         if forced_first_id is not None:
@@ -54,13 +63,35 @@ def greedy_scan(
         if forced_last_id is not None:
             nxt = jnp.where(step == last, jnp.int32(forced_last_id), nxt)
         nxt = jnp.where(done, jnp.full_like(nxt, pad_id), nxt)
-        return (nxt, done | (nxt == eos_id), caches), nxt
+        return nxt, done | (nxt == eos_id), caches
 
-    (_, _, _), toks = jax.lax.scan(
-        body, (bos, done0, caches),
-        jnp.arange(max_new_tokens, dtype=jnp.int32),
-    )
-    toks = toks.T  # [B, T]
+    if early_exit:
+        toks0 = jnp.full((batch, max_new_tokens), pad_id, dtype=jnp.int32)
+
+        def cond(carry):
+            step, _, done, _, _ = carry
+            return jnp.logical_and(step < max_new_tokens, ~jnp.all(done))
+
+        def body(carry):
+            step, tok, done, toks, caches = carry
+            nxt, done, caches = step_tok(tok, done, caches, step)
+            toks = toks.at[:, step].set(nxt)
+            return step + 1, nxt, done, toks, caches
+
+        _, _, _, toks, _ = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), bos, done0, toks0, caches)
+        )
+    else:
+        def body(carry, step):
+            tok, done, caches = carry
+            nxt, done, caches = step_tok(tok, done, caches, step)
+            return (nxt, done, caches), nxt
+
+        (_, _, _), toks = jax.lax.scan(
+            body, (bos, done0, caches),
+            jnp.arange(max_new_tokens, dtype=jnp.int32),
+        )
+        toks = toks.T  # [B, T]
     lengths = jnp.sum((toks != pad_id) & (toks != eos_id), axis=1)
     return toks, lengths
 

@@ -92,7 +92,9 @@ def init_params(cfg: EncoderConfig, model_id: str = "classify-default") -> Param
         mcfg = moe_cfg_of(cfg)
         for i, blk in enumerate(blocks):
             del blk["ffn"]
-            # Fold the layer index into the key so experts differ per layer.
+            # ks[i + 1] already differs per layer; the fold_in decorrelates
+            # the MoE init from init_block's split of the SAME per-layer key
+            # (the attention weights above consumed splits of ks[i + 1]).
             blk["moe"] = moe.init_moe_ffn(
                 jax.random.fold_in(ks[i + 1], 0x40E), mcfg
             )

@@ -223,6 +223,15 @@ def encoder_block(
     if "moe" in p:
         from agent_tpu.models import moe as moe_mod
 
+        if moe_ctx is None:
+            # Fail with the contract, not an unpack TypeError deep inside a
+            # traced shard_map: every MoE-capable entry point must resolve
+            # the (MoeConfig, mesh) pair (encoder.forward does; the pp
+            # pipeline intentionally does not — pp+MoE is unsupported).
+            raise ValueError(
+                "encoder block has a 'moe' subtree but no moe_ctx was "
+                "threaded — this forward path does not support MoE configs"
+            )
         mcfg, mesh = moe_ctx
         B, L, d = h.shape
         y, _aux = moe_mod.moe_ffn(

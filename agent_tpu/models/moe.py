@@ -70,8 +70,10 @@ def init_moe_ffn(key: jax.Array, cfg: MoeConfig) -> Params:
     }
 
 
-def moe_param_specs(cfg: MoeConfig) -> Params:
-    """PartitionSpecs: experts over ``ep``, router replicated."""
+def moe_param_specs(cfg: MoeConfig = None) -> Params:
+    """PartitionSpecs: experts over ``ep``, router replicated. The layout
+    is structural (no config dependence); ``cfg`` stays for call-site
+    symmetry with the other spec builders."""
     return {
         "router": {"w": P()},
         "wi": P("ep", None, None),

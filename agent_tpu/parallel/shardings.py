@@ -65,17 +65,15 @@ def _block_specs(cross: bool = False) -> Params:
 
 
 def _moe_block_specs() -> Params:
-    """Block with a Switch MoE FFN: experts over ``ep``, router replicated
-    (``models.moe.moe_param_specs`` layout inside the encoder block)."""
+    """Block with a Switch MoE FFN — the moe subtree's specs come from the
+    ONE definition in ``models.moe`` so the two trees cannot diverge."""
+    from agent_tpu.models.moe import moe_param_specs
+
     return {
         "ln1": _ln_specs(),
         "attn": _attn_specs(),
         "ln2": _ln_specs(),
-        "moe": {
-            "router": {"w": P()},
-            "wi": P("ep", None, None),
-            "wo": P("ep", None, None),
-        },
+        "moe": moe_param_specs(),
     }
 
 

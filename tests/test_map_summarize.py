@@ -275,3 +275,70 @@ def test_summarize_drain_blank_cells_get_empty_summaries(tmp_path):
     })
     assert out["ok"] is True
     assert out["summaries"][1] == ""          # blank cell → empty summary
+
+
+def test_greedy_early_exit_equals_scan_path():
+    """The while_loop early-exit decode must emit EXACTLY the fixed-trip
+    scan's tokens — including rows that hit EOS at different steps and the
+    pad tail after the early stop."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from agent_tpu.models import decoding
+
+    B, V, T = 4, 11, 12
+    eos = 9
+
+    # Scripted logits: row b emits token (step + b) % 7 + 1 until its EOS
+    # step (2 + 2*b), then would emit garbage — EOS bookkeeping must pad.
+    def step_fn(tok, step, caches):
+        logits = jnp.full((B, V), -1e9, dtype=jnp.float32)
+        for b in range(B):
+            want = jnp.where(step == 2 + 2 * b, eos, (step + b) % 7 + 1)
+            logits = logits.at[b, :].set(
+                jnp.where(jnp.arange(V) == want, 0.0, -1e9)
+            )
+        return logits, caches
+
+    kw = dict(batch=B, max_new_tokens=T, start_id=0, eos_id=eos, pad_id=0)
+    toks_w, lens_w = decoding.greedy_scan(step_fn, None, early_exit=True, **kw)
+    toks_s, lens_s = decoding.greedy_scan(step_fn, None, early_exit=False, **kw)
+    np.testing.assert_array_equal(np.asarray(toks_w), np.asarray(toks_s))
+    np.testing.assert_array_equal(np.asarray(lens_w), np.asarray(lens_s))
+    # Longest row finishes at step 2 + 2*(B-1) = 8 < T: the early-exit tail
+    # must be pad, proving the buffer semantics (not just luck).
+    assert np.all(np.asarray(toks_w)[:, 9:] == 0)
+
+
+def test_greedy_early_exit_under_jit_with_caches():
+    """Early exit must compose with jit and a threaded KV-cache pytree
+    (the real decode shape: caches in the while_loop carry)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from agent_tpu.models import decoding
+
+    B, V, T = 2, 8, 6
+    eos = 7
+
+    def step_fn(tok, step, caches):
+        # Cache carries a running sum — proves the pytree threads through.
+        caches = {"acc": caches["acc"] + tok.sum()}
+        logits = jax.nn.one_hot(
+            jnp.where(step >= 1, eos, (tok + 1) % V), V, dtype=jnp.float32
+        )
+        return jnp.log(logits + 1e-9), caches
+
+    caches = {"acc": jnp.int32(0)}
+
+    def run(early):
+        return decoding.greedy_scan(
+            step_fn, caches, batch=B, max_new_tokens=T,
+            start_id=1, eos_id=eos, pad_id=0, early_exit=early,
+        )
+
+    toks_w, lens_w = jax.jit(lambda: run(True))()
+    toks_s, lens_s = jax.jit(lambda: run(False))()
+    np.testing.assert_array_equal(np.asarray(toks_w), np.asarray(toks_s))
+    np.testing.assert_array_equal(np.asarray(lens_w), np.asarray(lens_s))
