@@ -123,7 +123,8 @@ def forward(
     attn_fn=layers.dot_product_attention,
     remat: bool = False,
     mesh=None,
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Logits [B, n_classes] (f32). Mean-pool over real tokens, linear head.
 
     ``remat=True`` wraps each block in ``jax.checkpoint`` so the backward
@@ -134,6 +135,12 @@ def forward(
     ``mesh`` matters only for MoE configs (``moe_experts > 0``): when it
     carries an ``ep`` axis the expert batches get explicit sharding
     constraints so the experts provably land on ``ep``.
+
+    ``with_aux=True`` returns ``(logits, aux)`` — the mean Switch
+    load-balancing loss over blocks (0.0 for dense configs). Blocks return
+    their aux through the (possibly checkpointed) block_fn, never via
+    side-channel closures: a Python-list accumulator would leak tracers
+    out of ``jax.checkpoint``'s inner trace.
     """
     dtype = cfg.compute_dtype
     L = ids.shape[1]
@@ -145,24 +152,23 @@ def forward(
             moe_cfg_of(cfg),
             mesh if mesh is not None and "ep" in mesh.shape else None,
         )
-    block_fn = (
-        jax.checkpoint(
-            lambda p, h, m: layers.encoder_block(
-                p, h, m, dtype, attn_fn=attn_fn, moe_ctx=moe_ctx
-            )
-        )
-        if remat
-        else (lambda p, h, m: layers.encoder_block(
-            p, h, m, dtype, attn_fn=attn_fn, moe_ctx=moe_ctx
-        ))
+    block_fn = lambda p, h, m: layers.encoder_block(  # noqa: E731
+        p, h, m, dtype, attn_fn=attn_fn, moe_ctx=moe_ctx, with_aux=True
     )
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
+    aux_total = jnp.float32(0.0)
     for block in params["blocks"]:
-        x = block_fn(block, x, attn_mask)
+        x, aux = block_fn(block, x, attn_mask)
+        aux_total = aux_total + aux
     x = layers.layer_norm(params["ln_f"], x)
     denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1).astype(jnp.float32)
     pooled = (x.astype(jnp.float32) * mask[:, :, None]).sum(axis=1) / denom
     logits = layers.dense(params["head"], pooled.astype(dtype), dtype)
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if with_aux:
+        return logits, aux_total / max(1, cfg.n_layers)
+    return logits
 
 
 def topk_probs(logits: jax.Array, k: int):

@@ -207,14 +207,19 @@ def init_block(key: jax.Array, d_model: int, n_heads: int, d_ff: int,
 
 def encoder_block(
     p: Params, x: jax.Array, mask: jax.Array, dtype: Any,
-    attn_fn=dot_product_attention, moe_ctx=None,
-) -> jax.Array:
+    attn_fn=dot_product_attention, moe_ctx=None, with_aux: bool = False,
+):
     """Pre-LN transformer block: x + Attn(LN(x)); x + FFN(LN(x)).
 
     A block carrying a ``moe`` subtree (``encoder.init_params`` with
     ``moe_experts > 0``) routes its FFN sublayer through the Switch MoE
     layer; ``moe_ctx`` is the ``(MoeConfig, mesh-or-None)`` pair the caller
     (``encoder.forward``) resolved once for the whole stack.
+
+    ``with_aux=True`` returns ``(x, aux)`` where ``aux`` is the block's
+    Switch load-balancing auxiliary loss (0.0 for dense blocks) — the
+    training path MUST use it for MoE configs (a router trained without
+    the aux term collapses onto one expert); serving ignores it.
     """
     h = layer_norm(p["ln1"], x)
     a, _ = attention(p["attn"], h, h, mask, dtype, attn_fn=attn_fn)
@@ -234,11 +239,13 @@ def encoder_block(
             )
         mcfg, mesh = moe_ctx
         B, L, d = h.shape
-        y, _aux = moe_mod.moe_ffn(
+        y, aux = moe_mod.moe_ffn(
             p["moe"], h.astype(dtype).reshape(B * L, d), mcfg, mesh=mesh
         )
-        return x + y.reshape(B, L, d).astype(x.dtype)
-    return x + ffn(p["ffn"], h, dtype)
+        out = x + y.reshape(B, L, d).astype(x.dtype)
+        return (out, aux) if with_aux else out
+    out = x + ffn(p["ffn"], h, dtype)
+    return (out, jnp.float32(0.0)) if with_aux else out
 
 
 def decoder_block(

@@ -25,16 +25,30 @@ from agent_tpu.models import encoder, layers
 from agent_tpu.parallel import shardings
 
 
+# Switch Transformer's load-balance coefficient (α, Switch §2.2): small
+# enough not to fight the task loss, large enough to keep routing uniform.
+MOE_AUX_WEIGHT = 0.01
+
+
 def cross_entropy_loss(
     params, ids: jax.Array, mask: jax.Array, labels: jax.Array, cfg,
     remat: bool = False, attn_fn=None,
 ) -> jax.Array:
+    """Mean NLL; MoE configs add the Switch load-balancing aux loss
+    (α=0.01) — training a router WITHOUT it collapses routing onto one
+    expert (capacity-dropped tokens pass through with zero FFN output and
+    the imbalance is self-reinforcing)."""
     attn_fn = attn_fn or layers.dot_product_attention
-    logits = encoder.forward(params, ids, mask, cfg, remat=remat,
-                             attn_fn=attn_fn)
+    moe = getattr(cfg, "moe_experts", 0) > 0
+    out = encoder.forward(params, ids, mask, cfg, remat=remat,
+                          attn_fn=attn_fn, with_aux=moe)
+    logits, aux = out if moe else (out, None)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
-    return nll.mean()
+    loss = nll.mean()
+    if moe:
+        loss = loss + MOE_AUX_WEIGHT * aux
+    return loss
 
 
 def make_train_step(cfg, optimizer=None, remat: bool = False, attn_fn=None):

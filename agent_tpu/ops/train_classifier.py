@@ -173,18 +173,14 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         return bad_input(
             f"label id {int(labels.max())} >= n_classes {cfg.n_classes}"
         )
-    # Serving-strategy fields are not trainable here: MoE training needs
-    # the Switch load-balancing aux loss in the objective (the serving
-    # forward discards it — a router trained without it collapses onto one
-    # expert), and the pp schedule isn't wired into the train step. Reject
-    # loudly instead of silently training a degenerate model.
-    if cfg.moe_experts > 0:
-        return bad_input(
-            "train_classifier does not support moe_experts configs "
-            "(no load-balancing aux loss in the training objective yet)"
-        )
+    # MoE configs train for real: cross_entropy_loss adds the Switch
+    # load-balancing aux term (models/train.py MOE_AUX_WEIGHT) so the
+    # router learns balanced routing. The pp schedule, by contrast, is not
+    # wired into the train step — reject rather than silently train dense.
     if cfg.pp > 1:
         return bad_input("train_classifier does not support pp configs")
+    if cfg.moe_experts > 0 and cfg.quant == "int8":
+        return bad_input("MoE training does not support quant=int8")
 
     if ctx is not None and getattr(ctx, "require_runtime", None):
         runtime = ctx.require_runtime()

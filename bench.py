@@ -76,9 +76,11 @@ TRAIN_STEPS = 8
 DRAIN_ROWS = 65_536
 DRAIN_SHARD_SIZE = 8192
 DRAIN_SUMMARIZE_ROWS = 16_384
-# One big decode program per shard: summarize throughput scales with decode
-# batch (measured 4,980 / 6,588 / 7,779 / 8,093 rows/s at B = 1k/2k/4k/8k —
-# per-step matmuls are [B, d_model]-thin, so only batch fills the MXU).
+# Summarize throughput scales with decode rows in flight: measured 4,980 /
+# 6,588 / 7,779 / 8,093 rows/s at payload 1k/2k/4k/8k (chained ≤1024-row
+# programs at the time), 9,132 as ONE B=8192 program — per-step decode
+# matmuls are [B, d_model]-thin, so only batch fills the MXU (see
+# ops/map_summarize.MAX_DECODE_ROWS).
 DRAIN_SUMMARIZE_SHARD = 8192
 
 # Peak dense bf16 FLOP/s by device_kind (public spec sheets); MFU is achieved
