@@ -156,6 +156,10 @@ def forward(
         p, h, m, dtype, attn_fn=attn_fn, moe_ctx=moe_ctx, with_aux=True
     )
     if remat:
+        # Full-block recompute (minimum memory). Selective policies were
+        # swept on v5e at BERT-base/seq-512 and lost: dots-saveable OOMs at
+        # batch 256 and ties full remat at 128 (247 vs 246 ex/s); with the
+        # flash-train kernel the winner is no remat at all (bench `train`).
         block_fn = jax.checkpoint(block_fn)
     aux_total = jnp.float32(0.0)
     for block in params["blocks"]:

@@ -113,8 +113,9 @@ def train_step_sharded(runtime, cfg, batch_size: int, seq_len: int,
     one jitted fwd+bwd+update executed on the runtime's mesh.
 
     ``attn_fn=None`` selects via ``runtime.train_attention_fn()`` — the
-    differentiable flash kernel on TPU at ≥``FLASH_MIN_KEY_LEN``, dense
-    otherwise.
+    differentiable flash kernel on TPU at ≥``FLASH_TRAIN_MIN_KEY_LEN``
+    (512 — the training gate sits below serving's 2048, see the gate note
+    in ``kernels/flash_attention.py``), dense otherwise.
     """
     mesh = runtime.mesh
     params = encoder.init_params(cfg, model_id="train-dryrun")

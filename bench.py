@@ -72,7 +72,11 @@ LONG_CTX_ITERS = 5
 LONG_CTX_CONFIG = {"d_model": 512, "n_heads": 4, "max_len": 4096}
 SUMMARIZE_BATCH = 256
 SUMMARIZE_MAX_NEW = 32
-TRAIN_BATCH = 256
+# Batch 128 + remat-free is the measured optimum now that the trainable
+# flash kernel gates at 512 (FLASH_TRAIN_MIN_KEY_LEN): no stored score
+# tensors OR block activations. Swept on v5e: 128/none 308 ex/s (45.3%
+# MFU) > 256/full-remat 246 (36.2%) > 512/full 230; 256/none OOMs.
+TRAIN_BATCH = 128
 TRAIN_STEPS = 8
 DRAIN_ROWS = 65_536
 DRAIN_SHARD_SIZE = 8192
@@ -380,15 +384,28 @@ def _bench_train(runtime):
     params = jax.device_put(
         encoder.init_params(cfg, model_id="bench-train"), runtime.replicated()
     )
-    # remat: stored [B, H, L, L] attention scores for backward would need
-    # ~39 GB at this scale; recompute them instead (flops ratio below
-    # already accounts for the fwd+bwd cost, remat's extra fwd is ~free on
-    # the MFU denominator side — we report achieved/peak of the 3x model).
-    # train_attention_fn: the differentiable flash kernel on TPU — at seq
-    # 512 it trace-time-selects dense anyway (FLASH_MIN_KEY_LEN), but the
-    # leg exercises the product selection path, not a bench-local choice.
+    # remat=False when the TRAINING flash gate selects the kernel: its
+    # backward stores no [B, H, L, L] scores, so at batch 128 the whole
+    # backward fits without rematerialization — the measured optimum (see
+    # TRAIN_BATCH note). selects_flash_train (not the attn_fn identity!)
+    # also covers the mesh wrapper's dp/tp-divisibility dense fallback.
+    # Off-TPU (dense path) the smoke shapes are tiny and need no remat; a
+    # TPU run with pallas disabled keeps remat=True to avoid the ~39 GB
+    # dense score store.
+    import importlib
+
+    fa = importlib.import_module("agent_tpu.kernels.flash_attention")
+    from agent_tpu.models.layers import dot_product_attention
+
+    attn_fn = runtime.train_attention_fn()
+    flash_train = (
+        attn_fn is not dot_product_attention
+        and fa.selects_flash_train(
+            seq, batch=batch, n_heads=cfg.n_heads, mesh=runtime.mesh
+        )
+    )
     init_state, step = make_train_step(
-        cfg, remat=not smoke, attn_fn=runtime.train_attention_fn()
+        cfg, remat=not (smoke or flash_train), attn_fn=attn_fn
     )
     opt_state = init_state(params)
     rng = np.random.default_rng(0)
@@ -403,9 +420,16 @@ def _bench_train(runtime):
     # TWO warmup steps: the first compiles for the init-state avals, the
     # second for the steady-state ones (the returned opt_state's weak-typed
     # scalars become strong, which retriggers compilation exactly once).
+    before_ft = fa.SELECTION_COUNTS.get("flash_train", 0)
     for _ in range(2):
         params, opt_state, loss = step(params, opt_state, ids, mask, labels)
         float(loss)
+    if flash_train:
+        # The remat=False decision above is only safe on the kernel path —
+        # prove the compiled step actually contains it.
+        assert fa.SELECTION_COUNTS.get("flash_train", 0) > before_ft, (
+            "train leg disabled remat but the flash kernel was not selected"
+        )
 
     def window():
         nonlocal params, opt_state
@@ -474,8 +498,16 @@ def _bench_train_long_ctx(runtime):
     # remat=False ON PURPOSE: the flash backward keeps [L, L] score
     # tensors out of HBM in both directions, so 262k tokens of activations
     # fit without rematerialization — measured 1.36× faster than the
-    # remat step (400 vs 295 ex/s). The seq-512 BERT-base train leg still
-    # remats (dense attention at that length stores scores).
+    # remat step (400 vs 295 ex/s). The seq-512 train leg now does the
+    # same (FLASH_TRAIN_MIN_KEY_LEN gates at 512). Disabling remat is only
+    # safe on the kernel path, so consult the selection predicate (which
+    # includes the mesh wrapper's dp/tp fallback) rather than assuming —
+    # a dense fallback here would store 262k-token score tensors and OOM
+    # before the post-warmup counter assert could explain why.
+    if not fa.selects_flash_train(
+        seq, batch=batch, n_heads=cfg.n_heads, mesh=runtime.mesh
+    ):
+        return {"skipped": "flash-train kernel not selectable on this mesh"}
     init_state, step = make_train_step(
         cfg, remat=False, attn_fn=runtime.train_attention_fn()
     )

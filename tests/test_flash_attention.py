@@ -306,3 +306,26 @@ def test_mesh_trainable_grads_on_dp_tp_mesh():
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
         )
+
+
+def test_selects_flash_train_gate_and_mesh_divisibility():
+    """The training-path predicate: 512 gate (below serving's 2048) AND the
+    mesh wrapper's dp/tp divisibility fallback — the remat-off decision in
+    bench's train leg rides on exactly this logic."""
+    import importlib
+
+    from agent_tpu.runtime.mesh import build_mesh
+
+    fa_mod = importlib.import_module("agent_tpu.kernels.flash_attention")
+    sel = fa_mod.selects_flash_train
+    assert sel(512, batch=128, n_heads=12)
+    assert not sel(256, batch=128, n_heads=12)        # below training gate
+    assert not sel(520, batch=128, n_heads=12)        # tile-indivisible
+    assert fa_mod.selects_flash(512, min_key_len=None) is False  # serving: 2048
+
+    mesh = build_mesh(jax.devices("cpu")[:8], {"dp": 4, "tp": 2})
+    assert sel(512, batch=128, n_heads=12, mesh=mesh)
+    assert not sel(512, batch=126, n_heads=12, mesh=mesh)  # B % dp != 0
+    assert not sel(512, batch=128, n_heads=11, mesh=mesh)  # H % tp != 0
+    one = build_mesh(jax.devices("cpu")[:1], {"dp": 1})
+    assert sel(512, batch=1, n_heads=3, mesh=one)     # size-1 mesh: no wrapper
