@@ -213,6 +213,37 @@ def _bench_bert_base(runtime):
     return leg
 
 
+MOE_EXPERTS = 8
+
+
+def _bench_moe(runtime):
+    """Switch-MoE encoder served through ``map_classify_tpu`` — the EP
+    capability (SURVEY §2.8, `models/moe.py`) as a recorded throughput
+    number beside the dense legs: BERT-base width with every FFN replaced
+    by an 8-expert top-1 MoE (8× the FFN parameters, ~dense activated
+    FLOPs per token + routing). Single chip ⇒ experts unsharded; the ep>1
+    placement itself is proven in tests/dryrun, this leg prices the
+    routed-execution overhead."""
+    smoke = runtime.platform != "tpu"
+    cfg = {
+        **BERT_CONFIG, "moe_experts": MOE_EXPERTS,
+        "quant": "none",
+    } if not smoke else {
+        "d_model": 64, "n_heads": 4, "n_layers": 2, "d_ff": 128,
+        "max_len": 64, "moe_experts": 4, "quant": "none",
+    }
+    leg = _bench_classify_leg(
+        runtime,
+        batch=64 if smoke else 1024,
+        text_len=480,
+        iters=1 if smoke else BERT_ITERS,
+        windows=1 if smoke else WINDOWS,
+        model_config=cfg,
+    )
+    leg["moe_experts"] = cfg["moe_experts"]
+    return leg
+
+
 def _bench_bert_base_int8(runtime, bf16_leg):
     """BERT-base classify with ``model_config {"quant": "int8"}`` (W8A8,
     models/quant.py) — the reference's INT8 device story as an execution
@@ -788,6 +819,7 @@ def main() -> int:
         ("bert_base", lambda: _bench_bert_base(runtime)),
         ("bert_base_int8", lambda: _bench_bert_base_int8(
             runtime, legs.get("bert_base"))),
+        ("moe", lambda: _bench_moe(runtime)),
         ("long_ctx", lambda: _bench_long_ctx(runtime)),
         ("train", lambda: _bench_train(runtime)),
         ("train_long_ctx", lambda: _bench_train_long_ctx(runtime)),
@@ -861,6 +893,7 @@ def main() -> int:
                 "int8_agreement_top1": legs["bert_base_int8"].get(
                     "agreement_top1"
                 ),
+                "moe_rows_per_sec": legs["moe"].get("rows_per_sec"),
                 "long_ctx_rows_per_sec": legs["long_ctx"].get("rows_per_sec"),
                 "train_examples_per_sec": legs["train"].get("examples_per_sec"),
                 "train_mfu": legs["train"].get("mfu"),

@@ -87,3 +87,60 @@ def test_moe_ep_sharded_matches_unsharded():
         np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
     )
     assert abs(float(aux_got) - float(aux_want)) < 1e-5
+
+
+def test_moe_grouped_routing_matches_concat_of_groups():
+    """Multi-group routing == routing each group independently (per-group
+    capacity, per-group queues), and the padded tail group discards its pad
+    outputs. Also proves grouped + ep-sharded compose."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from agent_tpu.runtime.mesh import build_mesh
+
+    params = moe.init_moe_ffn(jax.random.PRNGKey(5), CFG)
+    x = _tokens(T=56, seed=5)          # 56 = 3×16 + 8 → padded tail group
+    got, aux = moe.moe_ffn(params, x, CFG, group_size=16)
+
+    pad = jnp.zeros((8, CFG.d_model), x.dtype)
+    want_rows = []
+    for g in range(4):
+        chunk = x[16 * g: 16 * (g + 1)]
+        if chunk.shape[0] < 16:
+            chunk = jnp.concatenate([chunk, pad], axis=0)[:16]
+        y, _ = moe.moe_ffn(params, chunk, CFG)   # one group of 16
+        want_rows.append(np.asarray(y))
+    want = np.concatenate(want_rows, axis=0)[:56]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+    mesh = build_mesh(jax.devices()[:8], {"dp": 2, "ep": 4})
+    sharded_params = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params,
+        moe.moe_param_specs(CFG),
+    )
+    got_sh, aux_sh = jax.jit(
+        lambda p, x: moe.moe_ffn(p, x, CFG, mesh=mesh, group_size=16)
+    )(sharded_params, jax.device_put(x, NamedSharding(mesh, P())))
+    np.testing.assert_allclose(np.asarray(got_sh), want, rtol=1e-5, atol=1e-5)
+    assert abs(float(aux_sh) - float(aux)) < 1e-5
+
+
+def test_moe_aux_loss_ignores_pad_tokens():
+    """Aux statistics must exclude the zero-pad rows of a partial tail
+    group (a zero row's argmax is expert 0 — counting pads would bias the
+    router against it): aux(24 tokens, group 16) == mean of the two
+    groups' standalone aux (capacity is generous, so routing is identical
+    with or without padding)."""
+    params = moe.init_moe_ffn(jax.random.PRNGKey(7), CFG)
+    x = _tokens(T=24, seed=7)
+    _, aux = moe.moe_ffn(params, x, CFG, group_size=16)
+    _, aux_a = moe.moe_ffn(params, x[:16], CFG)
+    _, aux_b = moe.moe_ffn(params, x[16:], CFG)
+    want = (float(aux_a) + float(aux_b)) / 2.0
+    assert abs(float(aux) - want) < 1e-6
+
+
+def test_moe_empty_input():
+    params = moe.init_moe_ffn(jax.random.PRNGKey(8), CFG)
+    y, aux = moe.moe_ffn(params, _tokens(T=8, seed=8)[:0], CFG)
+    assert y.shape == (0, CFG.d_model) and float(aux) == 0.0
