@@ -32,6 +32,7 @@ class ExecutableCache:
         self._lock = threading.Lock()
         self._cache: Dict[Tuple[Hashable, ...], Any] = {}
         self._building: Dict[Tuple[Hashable, ...], threading.Event] = {}
+        self._generation = 0  # bumped by clear(); fences in-flight builds
         self.hits = 0
         self.misses = 0
 
@@ -48,12 +49,18 @@ class ExecutableCache:
                 if ev is None:
                     self._building[key] = threading.Event()
                     self.misses += 1
+                    gen = self._generation
                     break
             ev.wait()  # someone else is compiling this key
         try:
             fn = build()
             with self._lock:
-                self._cache[key] = fn
+                # A clear() that raced this build wins: return the value to
+                # the caller but do NOT cache it, so a post-clear store is
+                # actually empty (for params, the HBM is released as soon as
+                # the caller drops the tree — the point of clear_params).
+                if gen == self._generation:
+                    self._cache[key] = fn
             return fn
         finally:
             with self._lock:
@@ -74,3 +81,4 @@ class ExecutableCache:
     def clear(self) -> None:
         with self._lock:
             self._cache.clear()
+            self._generation += 1

@@ -255,6 +255,20 @@ class TpuRuntime:
         self._params.evict(("params", model_id, "tp"))
         self._params.evict(("params", model_id, "rep"))
 
+    def clear_params(self) -> None:
+        """Drop EVERY resident model from the HBM params store.
+
+        The store is append-only by design (serving re-uses hot weights),
+        so a workload that cycles through many large one-off models — the
+        bench's 8-expert MoE tree is ~2 GB — must be able to give the HBM
+        back: without this, the r4 bench's later train legs hit
+        RESOURCE_EXHAUSTED on a 16 GB chip. Freeing is by reference drop;
+        the next ``get_params`` for any id simply re-transfers.
+        """
+        with self._params_lock:
+            self._model_ids.clear()
+        self._params.clear()
+
     # ---- compiled execution ----
 
     def compiled(

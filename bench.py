@@ -232,14 +232,21 @@ def _bench_moe(runtime):
         "d_model": 64, "n_heads": 4, "n_layers": 2, "d_ff": 128,
         "max_len": 64, "moe_experts": 4, "quant": "none",
     }
-    leg = _bench_classify_leg(
-        runtime,
-        batch=64 if smoke else 1024,
-        text_len=480,
-        iters=1 if smoke else BERT_ITERS,
-        windows=1 if smoke else WINDOWS,
-        model_config=cfg,
-    )
+    try:
+        leg = _bench_classify_leg(
+            runtime,
+            batch=64 if smoke else 1024,
+            text_len=480,
+            iters=1 if smoke else BERT_ITERS,
+            windows=1 if smoke else WINDOWS,
+            model_config=cfg,
+        )
+    finally:
+        # The 8-expert tree is ~2 GB resident; later legs (train at batch
+        # 128, summarize) need that HBM back — measured RESOURCE_EXHAUSTED
+        # without this, and a FAILED leg must release it too. Earlier legs'
+        # models re-transfer on their next use.
+        runtime.clear_params()
     leg["moe_experts"] = cfg["moe_experts"]
     return leg
 
@@ -412,6 +419,9 @@ def _bench_train(runtime):
     seq = 64 if smoke else 512
     steps = 2 if smoke else TRAIN_STEPS
 
+    # Remat-free training at batch 128 budgets essentially the whole chip;
+    # serving models resident from earlier legs would shave the headroom.
+    runtime.clear_params()
     params = jax.device_put(
         encoder.init_params(cfg, model_id="bench-train"), runtime.replicated()
     )

@@ -92,3 +92,70 @@ def test_describe_telemetry_shape():
     assert d["platform"] == "cpu"
     assert d["n_devices"] == 8
     assert d["mesh"] == {"dp": 8, "tp": 1, "sp": 1}
+
+
+def test_clear_params_empties_store_and_rebuilds():
+    """clear_params drops every resident model (HBM give-back for
+    many-model workloads — see the r4 bench RESOURCE_EXHAUSTED note) and
+    the next get_params rebuilds from scratch."""
+    import numpy as np
+
+    from agent_tpu.config import DeviceConfig
+    from agent_tpu.runtime.runtime import TpuRuntime
+
+    rt = TpuRuntime(config=DeviceConfig(tpu_disabled=True),
+                    devices=jax.devices("cpu")[:2])
+    builds = []
+
+    def build(tag):
+        def f():
+            builds.append(tag)
+            return {"w": np.ones((4, 4), np.float32)}
+        return f
+
+    rt.get_params("m-a", build("a"))
+    rt.get_params("m-b", build("b"))
+    rt.get_params("m-a", build("a2"))     # cached — no rebuild
+    assert builds == ["a", "b"]
+    assert len(rt._params) == 2
+    rt.clear_params()
+    assert len(rt._params) == 0
+    rt.get_params("m-a", build("a3"))
+    assert builds == ["a", "b", "a3"]
+
+
+def test_clear_params_fences_in_flight_build():
+    """A clear() racing an in-flight build must win: the late insert is
+    dropped so a post-clear store is actually empty (the HBM give-back
+    contract of clear_params)."""
+    import threading
+
+    import numpy as np
+
+    from agent_tpu.config import DeviceConfig
+    from agent_tpu.runtime.runtime import TpuRuntime
+
+    rt = TpuRuntime(config=DeviceConfig(tpu_disabled=True),
+                    devices=jax.devices("cpu")[:2])
+    build_started = threading.Event()
+    release_build = threading.Event()
+
+    def slow_build():
+        build_started.set()
+        release_build.wait(5)
+        return {"w": np.ones((2, 2), np.float32)}
+
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.setdefault(
+            "tree", rt.get_params("raced-model", slow_build)
+        )
+    )
+    t.start()
+    assert build_started.wait(5)
+    rt.clear_params()            # races the in-flight build
+    release_build.set()
+    t.join(5)
+    assert "tree" in out         # the caller still gets its params
+    assert len(rt._params) == 0  # ...but the cleared store stays empty
+    assert rt.describe()["models_resident"] == []
