@@ -108,27 +108,45 @@ def beam_scan(
     eos_id: int,
     pad_id: int = 0,
     length_penalty: float = 1.0,
+    early_stopping: bool = False,
     forced_first_id: Optional[int] = None,
     forced_last_id: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Beam-search decode → (tokens [B, T], lengths [B]); static shapes.
 
-    Beams flatten into the batch dim (the model's step executable is shared
-    with greedy at ``B*K`` rows); each step takes one top-K over the joint
-    ``[B, K*V]`` scores and gathers the KV caches along the beam axis.
-    Finished beams collapse their next-token distribution to ``pad_id`` at
-    zero cost, freezing their score. Selection normalizes by
-    ``length ** length_penalty``. ``num_beams=1`` reduces to exactly greedy.
+    HF ``BeamSearchScorer`` semantics, differential-tested token-exact
+    against ``transformers`` beam generation (tests/test_bart.py,
+    tests/test_map_summarize.py): each step takes the top-2K candidates of
+    the joint ``[B, K·V]`` scores; EOS candidates ranked < K bank their
+    hypothesis into a static K-slot finished store (normalized by HF's
+    length convention — sequence length INCLUDING the decoder start, i.e.
+    ``(step+1) ** length_penalty``); the K best non-EOS candidates continue
+    (gathering the KV caches along the beam axis). A row stops improving
+    once its store holds K hypotheses and — with ``early_stopping=False``,
+    the HF default — the best running candidate can no longer beat the
+    worst banked one; ``early_stopping=True`` stops at K banked outright.
+    After the scan, still-running beams of unfinished rows are banked at
+    full length, and each row emits its best hypothesis.
+
+    Beams flatten into the batch dim, so the model's step executable is
+    shared with greedy at ``B*K`` rows. ``num_beams=1`` degenerates to
+    greedy-with-banking: same emitted tokens as ``greedy_scan``.
     """
     B, K, V, T = batch, num_beams, vocab_size, max_new_tokens
+    K2 = 2 * K
     tok0 = jnp.full((B * K,), start_id, dtype=jnp.int32)
     # Step 0: all K beams are identical, so only beam 0 may survive top-K.
     scores0 = jnp.tile(
         jnp.array([0.0] + [NEG_INF] * (K - 1), dtype=jnp.float32), (B, 1)
     )
-    done0 = jnp.zeros((B, K), dtype=jnp.bool_)
-    toks0 = jnp.zeros((B, K, T), dtype=jnp.int32)
-    pad_only = jnp.full((V,), NEG_INF, dtype=jnp.float32).at[pad_id].set(0.0)
+    toks0 = jnp.full((B, K, T), pad_id, dtype=jnp.int32)
+    # Empty finished slots are -inf, NOT the finite NEG_INF: with a negative
+    # length_penalty a real hypothesis can normalize below -1e9, and an
+    # empty all-pad slot must never outrank a real hypothesis.
+    _EMPTY = jnp.float32(-jnp.inf)
+    fin_scores0 = jnp.full((B, K), _EMPTY, dtype=jnp.float32)  # normalized
+    fin_toks0 = jnp.full((B, K, T), pad_id, dtype=jnp.int32)
+    row_done0 = jnp.zeros((B,), dtype=jnp.bool_)
     forced_only = (
         jnp.full((V,), NEG_INF, dtype=jnp.float32).at[forced_first_id].set(0.0)
         if forced_first_id is not None
@@ -139,26 +157,86 @@ def beam_scan(
         if forced_last_id is not None
         else None
     )
+    lp = jnp.float32(length_penalty)
+
+    def bank(fin_scores, fin_toks, cand_norm, cand_toks):
+        """Merge candidate hypotheses into the K-slot finished store.
+        cand_norm [B, n] (NEG_INF = ineligible), cand_toks [B, n, T]."""
+        all_scores = jnp.concatenate([fin_scores, cand_norm], axis=1)
+        all_toks = jnp.concatenate([fin_toks, cand_toks], axis=1)
+        new_scores, sel = jax.lax.top_k(all_scores, K)          # [B, K]
+        new_toks = jnp.take_along_axis(all_toks, sel[:, :, None], axis=1)
+        return new_scores, new_toks
 
     def body(carry, step):
-        tok, scores, done, toks, caches = carry
+        tok, scores, toks, fin_scores, fin_toks, row_done, caches = carry
         logits, caches = step_fn(tok, step, caches)   # [B*K, V]
         logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
         if forced_only is not None:
             logp = jnp.where(step == 0, forced_only[None, None, :], logp)
         if forced_last is not None:
             logp = jnp.where(step == T - 1, forced_last[None, None, :], logp)
-        logp = jnp.where(done[:, :, None], pad_only[None, None, :], logp)
         flat = (scores[:, :, None] + logp).reshape(B, K * V)
-        new_scores, idx = jax.lax.top_k(flat, K)      # [B, K]
-        beam_idx = idx // V                           # [B, K] parent beam
-        new_tok = (idx % V).astype(jnp.int32)
+        cand_scores, idx = jax.lax.top_k(flat, K2)    # [B, 2K]
+        cand_beam = idx // V                          # [B, 2K] parent beam
+        cand_tok = (idx % V).astype(jnp.int32)
+        is_eos = cand_tok == eos_id
+
+        # --- bank EOS candidates (HF: only ranks < K are eligible, and
+        # only while the row is still open). Hypothesis length follows
+        # HF's convention: decoder start + step generated tokens, the EOS
+        # itself excluded from the count → (step + 1).
+        hyp_len = (step + 1).astype(jnp.float32)
+        eligible = is_eos & (jnp.arange(K2)[None, :] < K) & ~row_done[:, None]
+        cand_norm = jnp.where(
+            eligible, cand_scores / hyp_len ** lp, _EMPTY
+        )
+        # Candidate token buffers: parent prefix + EOS written at `step`.
+        par_toks = jnp.take_along_axis(toks, cand_beam[:, :, None], axis=1)
+        eos_col = jnp.full((B, K2, 1), eos_id, dtype=jnp.int32)
+        cand_toks = jax.lax.dynamic_update_slice(par_toks, eos_col,
+                                                 (0, 0, step))
+        fin_scores, fin_toks = bank(fin_scores, fin_toks, cand_norm,
+                                    cand_toks)
+
+        # --- continue with the K best non-EOS candidates (in score order).
+        non_eos_rank = jnp.cumsum(~is_eos, axis=1) - 1          # [B, 2K]
+        pos = jnp.arange(K2)[None, :]
+        # gather_pos[b, k] = candidate column of the k-th non-EOS; at the
+        # forced-last step every candidate may be EOS — the fallback 0 is
+        # harmless (the scan ends; finalize ignores running beams of rows
+        # whose store filled, which a forced-EOS step guarantees).
+        onehot = (
+            (~is_eos)[:, None, :]
+            & (non_eos_rank[:, None, :] == jnp.arange(K)[None, :, None])
+        )                                                        # [B, K, 2K]
+        gather_pos = jnp.where(onehot, pos[:, None, :], 0).sum(axis=2)
+        new_scores = jnp.take_along_axis(cand_scores, gather_pos, axis=1)
+        new_tok = jnp.take_along_axis(cand_tok, gather_pos, axis=1)
+        beam_idx = jnp.take_along_axis(cand_beam, gather_pos, axis=1)
+
+        # Rows already done freeze: keep beam 0, emit pad, scores frozen.
+        new_scores = jnp.where(row_done[:, None], scores, new_scores)
+        new_tok = jnp.where(row_done[:, None], pad_id, new_tok)
+        beam_idx = jnp.where(row_done[:, None], 0, beam_idx)
 
         toks = jnp.take_along_axis(toks, beam_idx[:, :, None], axis=1)
         toks = jax.lax.dynamic_update_slice(
             toks, new_tok[:, :, None], (0, 0, step)
-        )
-        done = jnp.take_along_axis(done, beam_idx, axis=1) | (new_tok == eos_id)
+        )  # frozen rows write pad over pad — a no-op by construction
+
+        # --- HF is_done: store full AND (early_stopping, or the best
+        # RUNNING beam — EOS candidates excluded, HF's
+        # `_check_early_stop_heuristic` uses the post-selection running
+        # scores — can no longer beat the banked worst under the
+        # current-length normalization).
+        full = jnp.isfinite(fin_scores[:, K - 1])
+        if early_stopping:
+            newly_done = full
+        else:
+            best_running = new_scores[:, 0] / hyp_len ** lp
+            newly_done = full & (best_running <= fin_scores[:, K - 1])
+        row_done = row_done | newly_done
 
         def reorder(c):
             x = c.reshape(B, K, *c.shape[1:])
@@ -166,15 +244,28 @@ def beam_scan(
             return jnp.take_along_axis(x, ix, axis=1).reshape(c.shape)
 
         caches = jax.tree_util.tree_map(reorder, caches)
-        return (new_tok.reshape(B * K), new_scores, done, toks, caches), None
+        return (
+            new_tok.reshape(B * K), new_scores, toks,
+            fin_scores, fin_toks, row_done, caches,
+        ), None
 
-    (_, scores, _, toks, _), _ = jax.lax.scan(
-        body, (tok0, scores0, done0, toks0, caches),
+    (_, scores, toks, fin_scores, fin_toks, row_done, _), _ = jax.lax.scan(
+        body,
+        (tok0, scores0, toks0, fin_scores0, fin_toks0, row_done0, caches),
         jnp.arange(T, dtype=jnp.int32),
     )
-    lengths = jnp.sum((toks != pad_id) & (toks != eos_id), axis=2)  # [B, K]
-    norm = scores / jnp.maximum(lengths, 1).astype(jnp.float32) ** length_penalty
-    best = jnp.argmax(norm, axis=1)
-    out = jnp.take_along_axis(toks, best[:, None, None], axis=1)[:, 0]
-    out_len = jnp.take_along_axis(lengths, best[:, None], axis=1)[:, 0]
+
+    # Finalize (HF): rows that never closed bank their running beams,
+    # normalized by their GENERATED length T — HF's unified rule is
+    # "normalize by the hypothesis's generated token count" (an in-scan
+    # banked hypothesis has step generated tokens + its EOS = step+1;
+    # a run-to-the-end beam has exactly T).
+    run_norm = jnp.where(
+        row_done[:, None], _EMPTY,
+        scores / jnp.float32(T) ** lp,
+    )
+    fin_scores, fin_toks = bank(fin_scores, fin_toks, run_norm, toks)
+
+    out = fin_toks[:, 0]                                        # [B, T]
+    out_len = jnp.sum((out != pad_id) & (out != eos_id), axis=1)
     return out, out_len

@@ -416,6 +416,7 @@ def generate(
     cfg: T5Config,
     max_new_tokens: int,
     num_beams: int = 1,
+    length_penalty: float = 1.0,
     kernel=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy (or beam) generation via the shared scan engines. Returns
@@ -464,7 +465,8 @@ def generate(
     )
     return beam_scan(
         step_fn, caches, B, cfg.vocab_size, T,
-        num_beams=K, start_id=cfg.decoder_start_id, eos_id=cfg.eos_id,
+        num_beams=K, length_penalty=length_penalty,
+        start_id=cfg.decoder_start_id, eos_id=cfg.eos_id,
         pad_id=cfg.pad_id,
     )
 

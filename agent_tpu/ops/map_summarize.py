@@ -172,6 +172,7 @@ def _stage_chunks(dp: int, texts: List[str], cfg, num_beams: int = 1,
 
 def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                    max_new: int, num_beams: int,
+                   length_penalty: float = 1.0,
                    family: str = "seq2seq") -> List[Tuple[Any, int]]:
     """Device phase: decode staged chunks → pending ``[(toks_dev, n), ...]``
     device arrays (deferred fetch — see the return comment below; same
@@ -217,7 +218,7 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
 
                 gen = lambda p, i, m: bart.generate(  # noqa: E731
                     p, i, m, cfg, max_new, num_beams=num_beams,
-                    attn_fn=attn_fn,
+                    length_penalty=length_penalty, attn_fn=attn_fn,
                 )
             elif family == "t5":
                 from agent_tpu.models import t5
@@ -231,7 +232,7 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                 t5_kernel = runtime.t5_attention_kernel()
                 gen = lambda p, i, m: t5.generate(  # noqa: E731
                     p, i, m, cfg, max_new, num_beams=num_beams,
-                    kernel=t5_kernel,
+                    length_penalty=length_penalty, kernel=t5_kernel,
                 )
             else:
                 gen = (
@@ -240,7 +241,7 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                     if num_beams <= 1
                     else (lambda p, i, m: seq2seq.beam_generate(
                         p, i, m, cfg, max_new, num_beams=num_beams,
-                        attn_fn=attn_fn))
+                        length_penalty=length_penalty, attn_fn=attn_fn))
                 )
 
             def run_gen(p, i, n):
@@ -251,7 +252,7 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
 
         fn = runtime.compiled(
             ("map_summarize", model_id, family, B, Ls, max_new, num_beams,
-             cfg_key(cfg)),
+             length_penalty, cfg_key(cfg)),
             build,
         )
         toks, _ = fn(
@@ -312,6 +313,17 @@ def stage(payload: Any, ctx: Optional[object] = None):
     if isinstance(num_beams, bool) or not isinstance(num_beams, int) or \
             not 1 <= num_beams <= 16:
         return "done", bad_input("num_beams must be an int in [1, 16]")
+    # Beam score normalization exponent (HF semantics: selection scores
+    # divide by length**length_penalty). bart-large-cnn — the reference's
+    # actual model — generates with 2.0; our default stays HF's generic 1.0.
+    length_penalty = payload.get("length_penalty", 1.0)
+    if isinstance(length_penalty, bool) or \
+            not isinstance(length_penalty, (int, float)) or \
+            not -4.0 <= float(length_penalty) <= 4.0:
+        return "done", bad_input(
+            "length_penalty must be a number in [-4, 4]"
+        )
+    length_penalty = float(length_penalty)
 
     from agent_tpu.ops._model_common import (
         validate_output_uri,
@@ -378,6 +390,7 @@ def stage(payload: Any, ctx: Optional[object] = None):
         "single": single,
         "max_new": max_new,
         "num_beams": num_beams,
+        "length_penalty": length_penalty,
         "model_id": model_id,
         "family": family,
         "cfg": cfg,
@@ -405,7 +418,8 @@ def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, An
 
     state["token_chunks"] = _decode_chunks(
         runtime, state["chunks"], state["model_id"], state["cfg"],
-        state["max_new"], state["num_beams"], family=state["family"],
+        state["max_new"], state["num_beams"],
+        length_penalty=state["length_penalty"], family=state["family"],
     )
     state["device"] = runtime.platform
     state["t_device"] = time.perf_counter()

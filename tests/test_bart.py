@@ -242,3 +242,77 @@ def test_unsupported_activation_function_fails_loudly(tmp_path):
     cfg["activation_function"] = "gelu"
     p.write_text(json.dumps(cfg))
     assert bart.BartConfig.from_hf_json(str(p)).d_model == 8
+
+
+def test_beam4_generation_matches_transformers(hf_dir):
+    """Beam search must be token-exact vs transformers' BeamSearchScorer —
+    the reference's actual decode mode was num_beams=4 (reference
+    ops/map_summarize.py:57). Covers the EOS-banking semantics (an early
+    EOS hypothesis must win over longer continuations when its normalized
+    score is best) and HF's length convention, across length penalties and
+    padded rows."""
+    path, torch_model = hf_dir
+    cfg, params = bart.load_hf_dir(path, dtype="float32")
+    rng = np.random.default_rng(11)
+    src = rng.integers(4, cfg.vocab_size, (4, 9)).astype(np.int32)
+    mask = np.ones((4, 9), dtype=np.int32)
+    mask[1, 6:] = 0
+    mask[3, 4:] = 0
+    for lp, T in ((1.0, 8), (2.0, 6), (0.5, 8)):
+        with torch.no_grad():
+            want = torch_model.generate(
+                input_ids=torch.tensor(src, dtype=torch.long),
+                attention_mask=torch.tensor(mask, dtype=torch.long),
+                max_new_tokens=T, num_beams=4, do_sample=False,
+                min_length=0, length_penalty=lp, early_stopping=False,
+            ).numpy()[:, 1:]
+        toks, _ = jax.jit(
+            lambda p, i, m, T=T, lp=lp: bart.generate(
+                p, i, m, cfg, T, num_beams=4, length_penalty=lp
+            )
+        )(params, src, mask)
+        toks = np.asarray(toks)
+        n = min(want.shape[1], T)
+        np.testing.assert_array_equal(toks[:, :n], want[:, :n])
+
+
+def test_beam_matches_transformers_without_forced_eos(tmp_path):
+    """The no-forced-EOS path (T5-style endings) exercises the finalize
+    normalization: rows that run to max_new_tokens bank their running
+    beams at generated length T, competing against earlier banked EOS
+    hypotheses — the case a forced-EOS final step can never reach. Also
+    covers a negative length_penalty (empty-slot sentinel must stay below
+    every real hypothesis)."""
+    cfg_hf = transformers.BartConfig(
+        vocab_size=64, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64, max_position_embeddings=64,
+        pad_token_id=1, bos_token_id=0, eos_token_id=2,
+        decoder_start_token_id=2, forced_bos_token_id=None,
+        forced_eos_token_id=None,
+    )
+    torch.manual_seed(398)
+    model = transformers.BartForConditionalGeneration(cfg_hf).eval()
+    d = str(tmp_path / "noforce")
+    model.save_pretrained(d, safe_serialization=False)
+    cfg, params = bart.load_hf_dir(d, dtype="float32")
+    rng = np.random.default_rng(103)
+    src = rng.integers(4, 64, (4, 9)).astype(np.int32)
+    mask = np.ones((4, 9), dtype=np.int32)
+    mask[2, 5:] = 0
+    for lp, T in ((1.0, 10), (-1.0, 6)):
+        with torch.no_grad():
+            want = model.generate(
+                input_ids=torch.tensor(src, dtype=torch.long),
+                attention_mask=torch.tensor(mask, dtype=torch.long),
+                max_new_tokens=T, num_beams=4, do_sample=False,
+                min_length=0, length_penalty=lp, early_stopping=False,
+            ).numpy()[:, 1:]
+        toks, _ = jax.jit(
+            lambda p, i, m, T=T, lp=lp: bart.generate(
+                p, i, m, cfg, T, num_beams=4, length_penalty=lp
+            )
+        )(params, src, mask)
+        toks = np.asarray(toks)
+        n = min(want.shape[1], T)
+        np.testing.assert_array_equal(toks[:, :n], want[:, :n])
