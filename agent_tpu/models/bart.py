@@ -307,6 +307,7 @@ def generate(
     max_new_tokens: int,
     num_beams: int = 1,
     length_penalty: float = 1.0,
+    early_stopping: bool = False,
     attn_fn=dot_product_attention,
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy (or beam) generation under one jit trace via the shared scan
@@ -352,6 +353,7 @@ def generate(
         step_fn, _init_self_caches(cfg, B * K, max_new_tokens), B,
         cfg.vocab_size, max_new_tokens,
         num_beams=K, length_penalty=length_penalty,
+        early_stopping=early_stopping,
         start_id=cfg.decoder_start_id, eos_id=cfg.eos_id,
         pad_id=cfg.pad_id, forced_first_id=cfg.forced_bos_id,
         forced_last_id=cfg.forced_eos_id,

@@ -115,8 +115,10 @@ def beam_scan(
     """Beam-search decode → (tokens [B, T], lengths [B]); static shapes.
 
     HF ``BeamSearchScorer`` semantics, differential-tested token-exact
-    against ``transformers`` beam generation (tests/test_bart.py,
-    tests/test_map_summarize.py): each step takes the top-2K candidates of
+    against ``transformers`` beam generation (tests/test_bart.py; the
+    engine-level invariants — beam1 == greedy, determinism, score
+    dominance — live in tests/test_map_summarize.py): each step takes the
+    top-2K candidates of
     the joint ``[B, K·V]`` scores; EOS candidates ranked < K bank their
     hypothesis into a static K-slot finished store (normalized by HF's
     length convention — sequence length INCLUDING the decoder start, i.e.
@@ -161,7 +163,8 @@ def beam_scan(
 
     def bank(fin_scores, fin_toks, cand_norm, cand_toks):
         """Merge candidate hypotheses into the K-slot finished store.
-        cand_norm [B, n] (NEG_INF = ineligible), cand_toks [B, n, T]."""
+        cand_norm [B, n] (``_EMPTY`` = ineligible — it must be -inf, see
+        the initializer note), cand_toks [B, n, T]."""
         all_scores = jnp.concatenate([fin_scores, cand_norm], axis=1)
         all_toks = jnp.concatenate([fin_toks, cand_toks], axis=1)
         new_scores, sel = jax.lax.top_k(all_scores, K)          # [B, K]
@@ -199,18 +202,16 @@ def beam_scan(
         fin_scores, fin_toks = bank(fin_scores, fin_toks, cand_norm,
                                     cand_toks)
 
-        # --- continue with the K best non-EOS candidates (in score order).
-        non_eos_rank = jnp.cumsum(~is_eos, axis=1) - 1          # [B, 2K]
-        pos = jnp.arange(K2)[None, :]
-        # gather_pos[b, k] = candidate column of the k-th non-EOS; at the
-        # forced-last step every candidate may be EOS — the fallback 0 is
-        # harmless (the scan ends; finalize ignores running beams of rows
-        # whose store filled, which a forced-EOS step guarantees).
-        onehot = (
-            (~is_eos)[:, None, :]
-            & (non_eos_rank[:, None, :] == jnp.arange(K)[None, :, None])
-        )                                                        # [B, K, 2K]
-        gather_pos = jnp.where(onehot, pos[:, None, :], 0).sum(axis=2)
+        # --- continue with the K best non-EOS candidates. cand_scores are
+        # already sorted descending and top_k tie-breaks by index, so this
+        # masked top_k returns the first K non-EOS columns in score order.
+        # EOS appears at most once per parent beam → at most K of the 2K
+        # candidates are EOS → K non-EOS always exist, except at a
+        # forced-last step (all mass on EOS) where the selection is
+        # irrelevant: the scan ends and every row's store just filled.
+        _, gather_pos = jax.lax.top_k(
+            jnp.where(is_eos, -jnp.inf, cand_scores), K
+        )
         new_scores = jnp.take_along_axis(cand_scores, gather_pos, axis=1)
         new_tok = jnp.take_along_axis(cand_tok, gather_pos, axis=1)
         beam_idx = jnp.take_along_axis(cand_beam, gather_pos, axis=1)
@@ -249,10 +250,25 @@ def beam_scan(
             fin_scores, fin_toks, row_done, caches,
         ), None
 
-    (_, scores, toks, fin_scores, fin_toks, row_done, _), _ = jax.lax.scan(
-        body,
-        (tok0, scores0, toks0, fin_scores0, fin_toks0, row_done0, caches),
-        jnp.arange(T, dtype=jnp.int32),
+    # while_loop, not scan: once every row is done further steps are pure
+    # frozen no-ops, so a batch of short summaries pays for its longest
+    # row, not for max_new_tokens — the same early exit greedy_scan makes.
+    # (Nothing backprops through beam decode, so the missing reverse rule
+    # costs nothing.)
+    def cond(carry):
+        return jnp.logical_and(carry[0] < T, ~jnp.all(carry[6]))
+
+    def wbody(carry):
+        step = carry[0]
+        new_carry, _ = body(carry[1:], step)
+        return (step + 1,) + new_carry
+
+    (_, _, scores, toks, fin_scores, fin_toks, row_done, _) = (
+        jax.lax.while_loop(
+            cond, wbody,
+            (jnp.int32(0), tok0, scores0, toks0,
+             fin_scores0, fin_toks0, row_done0, caches),
+        )
     )
 
     # Finalize (HF): rows that never closed bank their running beams,

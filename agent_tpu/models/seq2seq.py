@@ -171,6 +171,7 @@ def beam_generate(
     max_new_tokens: int,
     num_beams: int = 4,
     length_penalty: float = 1.0,
+    early_stopping: bool = False,
     attn_fn=layers.dot_product_attention,
 ) -> Tuple[jax.Array, jax.Array]:
     """Beam-search decode under one jit trace — static shapes throughout.
@@ -182,9 +183,11 @@ def beam_generate(
     reordering gathers the KV caches along the beam axis — all inside
     ``lax.scan``, so the program never retraces per step.
 
-    Finished beams are frozen: their row's next-token distribution collapses
-    to PAD at zero cost, so their score stops moving. Selection normalizes by
-    ``length ** length_penalty`` (1.0 = mean logprob; 0.0 = raw sum).
+    Semantics are HF ``BeamSearchScorer``-exact (see ``decoding.beam_scan``):
+    EOS hypotheses bank into a K-slot finished store normalized by
+    ``generated_length ** length_penalty``; ``early_stopping=True`` closes a
+    row as soon as the store fills (HF's generic default is False;
+    bart-large-cnn — the reference's model — generated with True).
 
     Returns (tokens [B, max_new_tokens], lengths [B]) like
     :func:`greedy_generate` (``num_beams=1`` reduces to exactly greedy).
@@ -202,7 +205,7 @@ def beam_generate(
     return beam_scan(
         step_fn, _empty_cache(cfg, B * K), B, cfg.vocab_size, max_new_tokens,
         num_beams=K, start_id=BOS_ID, eos_id=EOS_ID, pad_id=PAD_ID,
-        length_penalty=length_penalty,
+        length_penalty=length_penalty, early_stopping=early_stopping,
     )
 
 

@@ -417,6 +417,7 @@ def generate(
     max_new_tokens: int,
     num_beams: int = 1,
     length_penalty: float = 1.0,
+    early_stopping: bool = False,
     kernel=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy (or beam) generation via the shared scan engines. Returns
@@ -466,6 +467,7 @@ def generate(
     return beam_scan(
         step_fn, caches, B, cfg.vocab_size, T,
         num_beams=K, length_penalty=length_penalty,
+        early_stopping=early_stopping,
         start_id=cfg.decoder_start_id, eos_id=cfg.eos_id,
         pad_id=cfg.pad_id,
     )

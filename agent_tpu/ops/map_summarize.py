@@ -173,6 +173,7 @@ def _stage_chunks(dp: int, texts: List[str], cfg, num_beams: int = 1,
 def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                    max_new: int, num_beams: int,
                    length_penalty: float = 1.0,
+                   early_stopping: bool = False,
                    family: str = "seq2seq") -> List[Tuple[Any, int]]:
     """Device phase: decode staged chunks → pending ``[(toks_dev, n), ...]``
     device arrays (deferred fetch — see the return comment below; same
@@ -218,7 +219,8 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
 
                 gen = lambda p, i, m: bart.generate(  # noqa: E731
                     p, i, m, cfg, max_new, num_beams=num_beams,
-                    length_penalty=length_penalty, attn_fn=attn_fn,
+                    length_penalty=length_penalty,
+                    early_stopping=early_stopping, attn_fn=attn_fn,
                 )
             elif family == "t5":
                 from agent_tpu.models import t5
@@ -232,7 +234,8 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                 t5_kernel = runtime.t5_attention_kernel()
                 gen = lambda p, i, m: t5.generate(  # noqa: E731
                     p, i, m, cfg, max_new, num_beams=num_beams,
-                    length_penalty=length_penalty, kernel=t5_kernel,
+                    length_penalty=length_penalty,
+                    early_stopping=early_stopping, kernel=t5_kernel,
                 )
             else:
                 gen = (
@@ -241,7 +244,8 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                     if num_beams <= 1
                     else (lambda p, i, m: seq2seq.beam_generate(
                         p, i, m, cfg, max_new, num_beams=num_beams,
-                        length_penalty=length_penalty, attn_fn=attn_fn))
+                        length_penalty=length_penalty,
+                        early_stopping=early_stopping, attn_fn=attn_fn))
                 )
 
             def run_gen(p, i, n):
@@ -252,7 +256,7 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
 
         fn = runtime.compiled(
             ("map_summarize", model_id, family, B, Ls, max_new, num_beams,
-             length_penalty, cfg_key(cfg)),
+             length_penalty, early_stopping, cfg_key(cfg)),
             build,
         )
         toks, _ = fn(
@@ -324,6 +328,9 @@ def stage(payload: Any, ctx: Optional[object] = None):
             "length_penalty must be a number in [-4, 4]"
         )
     length_penalty = float(length_penalty)
+    early_stopping = payload.get("early_stopping", False)
+    if not isinstance(early_stopping, bool):
+        return "done", bad_input("early_stopping must be a bool")
 
     from agent_tpu.ops._model_common import (
         validate_output_uri,
@@ -391,6 +398,7 @@ def stage(payload: Any, ctx: Optional[object] = None):
         "max_new": max_new,
         "num_beams": num_beams,
         "length_penalty": length_penalty,
+        "early_stopping": early_stopping,
         "model_id": model_id,
         "family": family,
         "cfg": cfg,
@@ -419,7 +427,8 @@ def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, An
     state["token_chunks"] = _decode_chunks(
         runtime, state["chunks"], state["model_id"], state["cfg"],
         state["max_new"], state["num_beams"],
-        length_penalty=state["length_penalty"], family=state["family"],
+        length_penalty=state["length_penalty"],
+        early_stopping=state["early_stopping"], family=state["family"],
     )
     state["device"] = runtime.platform
     state["t_device"] = time.perf_counter()

@@ -316,3 +316,46 @@ def test_beam_matches_transformers_without_forced_eos(tmp_path):
         toks = np.asarray(toks)
         n = min(want.shape[1], T)
         np.testing.assert_array_equal(toks[:, :n], want[:, :n])
+
+
+def test_beam_early_stopping_matches_transformers(tmp_path):
+    """``early_stopping=True`` (bart-large-cnn's actual setting) follows
+    HF: a row closes as soon as K hypotheses are banked, regardless of
+    whether running beams could still improve. Token-exact vs
+    transformers on these seeds; note random tiny models can fork on
+    ~1e-6 cross-framework logit noise near repeated-token ties (logits
+    agree to 5e-7; trained models have decisive gaps), so seeds here are
+    ones whose distributions are decisive."""
+    cfg_hf = transformers.BartConfig(
+        vocab_size=64, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64, max_position_embeddings=64,
+        pad_token_id=1, bos_token_id=0, eos_token_id=2,
+        decoder_start_token_id=2, forced_bos_token_id=None,
+        forced_eos_token_id=None,
+    )
+    torch.manual_seed(3 * 77 + 3)
+    model = transformers.BartForConditionalGeneration(cfg_hf).eval()
+    d = str(tmp_path / "es")
+    model.save_pretrained(d, safe_serialization=False)
+    cfg, params = bart.load_hf_dir(d, dtype="float32")
+    rng = np.random.default_rng(53)
+    src = rng.integers(4, 64, (4, 9)).astype(np.int32)
+    mask = np.ones((4, 9), dtype=np.int32)
+    mask[0, 7:] = 0
+    for lp, T in ((1.0, 10), (2.0, 8)):
+        with torch.no_grad():
+            want = model.generate(
+                input_ids=torch.tensor(src, dtype=torch.long),
+                attention_mask=torch.tensor(mask, dtype=torch.long),
+                max_new_tokens=T, num_beams=4, do_sample=False,
+                min_length=0, length_penalty=lp, early_stopping=True,
+            ).numpy()[:, 1:]
+        toks, _ = jax.jit(
+            lambda p, i, m, T=T, lp=lp: bart.generate(
+                p, i, m, cfg, T, num_beams=4, length_penalty=lp,
+                early_stopping=True)
+        )(params, src, mask)
+        toks = np.asarray(toks)
+        n = min(want.shape[1], T)
+        np.testing.assert_array_equal(toks[:, :n], want[:, :n])
