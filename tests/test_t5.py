@@ -296,3 +296,32 @@ def test_encode_mesh_kernel_on_dp_tp_mesh(tmp_path, monkeypatch):
     toks_d, lens_d = t5.generate(params, src, mask, cfg, 4)
     np.testing.assert_array_equal(np.asarray(toks_k), np.asarray(toks_d))
     np.testing.assert_array_equal(np.asarray(lens_k), np.asarray(lens_d))
+
+
+def test_beam4_generation_matches_transformers(tmp_path):
+    """Beam decode through the T5 plumbing (no forced BOS/EOS — T5's
+    natural ending, the finalize-normalization path) must be token-exact
+    vs transformers, like the BART twin in tests/test_bart.py."""
+    model = _torch_model()
+    cfg, params = _import(model, tmp_path, "beam4")
+    rng = np.random.default_rng(6)
+    src = rng.integers(2, cfg.vocab_size, (3, 7)).astype(np.int32)
+    mask = np.ones((3, 7), dtype=np.int32)
+    mask[1, 5:] = 0
+    for lp, T in ((1.0, 8), (2.0, 6)):
+        with torch.no_grad():
+            want = model.generate(
+                input_ids=torch.tensor(src, dtype=torch.long),
+                attention_mask=torch.tensor(mask, dtype=torch.long),
+                max_new_tokens=T, num_beams=4, do_sample=False,
+                min_length=0, length_penalty=lp, early_stopping=False,
+                decoder_start_token_id=cfg.decoder_start_id,
+            ).numpy()[:, 1:]
+        toks, _ = jax.jit(
+            lambda p, i, m, T=T, lp=lp: t5.generate(
+                p, i, m, cfg, T, num_beams=4, length_penalty=lp
+            )
+        )(params, src, mask)
+        toks = np.asarray(toks)
+        n = min(want.shape[1], T)
+        np.testing.assert_array_equal(toks[:, :n], want[:, :n])
