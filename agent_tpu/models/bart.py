@@ -308,6 +308,7 @@ def generate(
     num_beams: int = 1,
     length_penalty: float = 1.0,
     early_stopping: bool = False,
+    min_length: int = 0,
     attn_fn=dot_product_attention,
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy (or beam) generation under one jit trace via the shared scan
@@ -331,7 +332,8 @@ def generate(
             step_fn, _init_self_caches(cfg, B, max_new_tokens), B,
             max_new_tokens,
             start_id=cfg.decoder_start_id, eos_id=cfg.eos_id,
-            pad_id=cfg.pad_id, forced_first_id=cfg.forced_bos_id,
+            pad_id=cfg.pad_id, min_length=min_length,
+            forced_first_id=cfg.forced_bos_id,
             forced_last_id=cfg.forced_eos_id,
         )
     K = num_beams
@@ -353,7 +355,7 @@ def generate(
         step_fn, _init_self_caches(cfg, B * K, max_new_tokens), B,
         cfg.vocab_size, max_new_tokens,
         num_beams=K, length_penalty=length_penalty,
-        early_stopping=early_stopping,
+        early_stopping=early_stopping, min_length=min_length,
         start_id=cfg.decoder_start_id, eos_id=cfg.eos_id,
         pad_id=cfg.pad_id, forced_first_id=cfg.forced_bos_id,
         forced_last_id=cfg.forced_eos_id,

@@ -23,6 +23,23 @@ from agent_tpu.models.layers import NEG_INF
 StepFn = Callable[[jax.Array, jax.Array, Any], Tuple[jax.Array, Any]]
 
 
+
+def _ban_eos_before(scores, step, min_length: int, eos_id: int):
+    """HF ``MinLengthLogitsProcessor``: EOS masked to ``NEG_INF`` while the
+    decoder sequence (start token + generated, HF's counting = step+1) is
+    below ``min_length``. Single-sourced so greedy and beam can never drift.
+    ``scores``: [..., V] logits or logprobs."""
+    if min_length <= 0:
+        return scores
+    v = scores.shape[-1]
+    lead = (1,) * (scores.ndim - 1)
+    return jnp.where(
+        (step + 1 < min_length)
+        & (jnp.arange(v) == eos_id).reshape(lead + (v,)),
+        NEG_INF, scores,
+    )
+
+
 def greedy_scan(
     step_fn: StepFn,
     caches: Any,
@@ -32,6 +49,7 @@ def greedy_scan(
     start_id: int,
     eos_id: int,
     pad_id: int = 0,
+    min_length: int = 0,
     forced_first_id: Optional[int] = None,
     forced_last_id: Optional[int] = None,
     early_exit: bool = True,
@@ -41,6 +59,9 @@ def greedy_scan(
     Rows emit ``pad_id`` after their EOS; ``forced_first_id`` (e.g. BART's
     ``forced_bos_token_id``) overrides the step-0 argmax, and
     ``forced_last_id`` (``forced_eos_token_id``) the final step's, when set.
+    ``min_length`` bans EOS while the sequence (decoder start + generated,
+    HF's counting) is shorter — HF ``MinLengthLogitsProcessor``; a forced
+    last token still wins, matching HF's processor order.
 
     ``early_exit=True`` (default) runs the decode as a ``lax.while_loop``
     that stops once EVERY row has emitted EOS — identical outputs (the
@@ -57,6 +78,7 @@ def greedy_scan(
 
     def step_tok(tok, done, caches, step):
         logits, caches = step_fn(tok, step, caches)
+        logits = _ban_eos_before(logits, step, min_length, eos_id)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         if forced_first_id is not None:
             nxt = jnp.where(step == 0, jnp.int32(forced_first_id), nxt)
@@ -109,6 +131,7 @@ def beam_scan(
     pad_id: int = 0,
     length_penalty: float = 1.0,
     early_stopping: bool = False,
+    min_length: int = 0,
     forced_first_id: Optional[int] = None,
     forced_last_id: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -175,6 +198,9 @@ def beam_scan(
         tok, scores, toks, fin_scores, fin_toks, row_done, caches = carry
         logits, caches = step_fn(tok, step, caches)   # [B*K, V]
         logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
+        # Applied BEFORE the forced substitutions, which replace the whole
+        # distribution — HF's processor order, so a forced EOS wins.
+        logp = _ban_eos_before(logp, step, min_length, eos_id)
         if forced_only is not None:
             logp = jnp.where(step == 0, forced_only[None, None, :], logp)
         if forced_last is not None:

@@ -137,6 +137,7 @@ def greedy_generate(
     src_mask: jax.Array,   # [B, Ls] int32
     cfg: Seq2SeqConfig,
     max_new_tokens: int,
+    min_length: int = 0,
     attn_fn=layers.dot_product_attention,
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy decode under one jit trace: ``lax.scan`` over static steps.
@@ -160,6 +161,7 @@ def greedy_generate(
     return greedy_scan(
         step_fn, _empty_cache(cfg, B), B, max_new_tokens,
         start_id=BOS_ID, eos_id=EOS_ID, pad_id=PAD_ID,
+        min_length=min_length,
     )
 
 
@@ -172,6 +174,7 @@ def beam_generate(
     num_beams: int = 4,
     length_penalty: float = 1.0,
     early_stopping: bool = False,
+    min_length: int = 0,
     attn_fn=layers.dot_product_attention,
 ) -> Tuple[jax.Array, jax.Array]:
     """Beam-search decode under one jit trace — static shapes throughout.
@@ -206,6 +209,7 @@ def beam_generate(
         step_fn, _empty_cache(cfg, B * K), B, cfg.vocab_size, max_new_tokens,
         num_beams=K, start_id=BOS_ID, eos_id=EOS_ID, pad_id=PAD_ID,
         length_penalty=length_penalty, early_stopping=early_stopping,
+        min_length=min_length,
     )
 
 

@@ -418,6 +418,7 @@ def generate(
     num_beams: int = 1,
     length_penalty: float = 1.0,
     early_stopping: bool = False,
+    min_length: int = 0,
     kernel=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy (or beam) generation via the shared scan engines. Returns
@@ -457,7 +458,7 @@ def generate(
         return greedy_scan(
             step_fn, caches, B, T,
             start_id=cfg.decoder_start_id, eos_id=cfg.eos_id,
-            pad_id=cfg.pad_id,
+            pad_id=cfg.pad_id, min_length=min_length,
         )
     K = num_beams
     step_fn, caches = run(
@@ -467,7 +468,7 @@ def generate(
     return beam_scan(
         step_fn, caches, B, cfg.vocab_size, T,
         num_beams=K, length_penalty=length_penalty,
-        early_stopping=early_stopping,
+        early_stopping=early_stopping, min_length=min_length,
         start_id=cfg.decoder_start_id, eos_id=cfg.eos_id,
         pad_id=cfg.pad_id,
     )

@@ -174,6 +174,7 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                    max_new: int, num_beams: int,
                    length_penalty: float = 1.0,
                    early_stopping: bool = False,
+                   min_length: int = 0,
                    family: str = "seq2seq") -> List[Tuple[Any, int]]:
     """Device phase: decode staged chunks → pending ``[(toks_dev, n), ...]``
     device arrays (deferred fetch — see the return comment below; same
@@ -220,7 +221,8 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                 gen = lambda p, i, m: bart.generate(  # noqa: E731
                     p, i, m, cfg, max_new, num_beams=num_beams,
                     length_penalty=length_penalty,
-                    early_stopping=early_stopping, attn_fn=attn_fn,
+                    early_stopping=early_stopping, min_length=min_length,
+                    attn_fn=attn_fn,
                 )
             elif family == "t5":
                 from agent_tpu.models import t5
@@ -235,17 +237,20 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
                 gen = lambda p, i, m: t5.generate(  # noqa: E731
                     p, i, m, cfg, max_new, num_beams=num_beams,
                     length_penalty=length_penalty,
-                    early_stopping=early_stopping, kernel=t5_kernel,
+                    early_stopping=early_stopping, min_length=min_length,
+                    kernel=t5_kernel,
                 )
             else:
                 gen = (
                     (lambda p, i, m: seq2seq.greedy_generate(
-                        p, i, m, cfg, max_new, attn_fn=attn_fn))
+                        p, i, m, cfg, max_new, min_length=min_length,
+                        attn_fn=attn_fn))
                     if num_beams <= 1
                     else (lambda p, i, m: seq2seq.beam_generate(
                         p, i, m, cfg, max_new, num_beams=num_beams,
                         length_penalty=length_penalty,
-                        early_stopping=early_stopping, attn_fn=attn_fn))
+                        early_stopping=early_stopping,
+                        min_length=min_length, attn_fn=attn_fn))
                 )
 
             def run_gen(p, i, n):
@@ -256,7 +261,7 @@ def _decode_chunks(runtime, chunks: List, model_id: str, cfg,
 
         fn = runtime.compiled(
             ("map_summarize", model_id, family, B, Ls, max_new, num_beams,
-             length_penalty, early_stopping, cfg_key(cfg)),
+             length_penalty, early_stopping, min_length, cfg_key(cfg)),
             build,
         )
         toks, _ = fn(
@@ -331,6 +336,12 @@ def stage(payload: Any, ctx: Optional[object] = None):
     early_stopping = payload.get("early_stopping", False)
     if not isinstance(early_stopping, bool):
         return "done", bad_input("early_stopping must be a bool")
+    # HF counting: min_length bounds the FULL decoder sequence (start +
+    # generated); bart-large-cnn generated with 56.
+    min_length = payload.get("min_length", 0)
+    if isinstance(min_length, bool) or not isinstance(min_length, int) or \
+            min_length < 0:
+        return "done", bad_input("min_length must be a non-negative int")
 
     from agent_tpu.ops._model_common import (
         validate_output_uri,
@@ -399,6 +410,7 @@ def stage(payload: Any, ctx: Optional[object] = None):
         "num_beams": num_beams,
         "length_penalty": length_penalty,
         "early_stopping": early_stopping,
+        "min_length": min_length,
         "model_id": model_id,
         "family": family,
         "cfg": cfg,
@@ -428,7 +440,8 @@ def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, An
         runtime, state["chunks"], state["model_id"], state["cfg"],
         state["max_new"], state["num_beams"],
         length_penalty=state["length_penalty"],
-        early_stopping=state["early_stopping"], family=state["family"],
+        early_stopping=state["early_stopping"],
+        min_length=state["min_length"], family=state["family"],
     )
     state["device"] = runtime.platform
     state["t_device"] = time.perf_counter()

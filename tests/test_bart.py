@@ -359,3 +359,42 @@ def test_beam_early_stopping_matches_transformers(tmp_path):
         toks = np.asarray(toks)
         n = min(want.shape[1], T)
         np.testing.assert_array_equal(toks[:, :n], want[:, :n])
+
+
+def test_min_length_matches_transformers(tmp_path):
+    """``min_length`` (HF counting — decoder start + generated tokens)
+    bans EOS until the bound is reached, in greedy AND beam; bart-large-cnn
+    generated with min_length=56. Token-exact vs transformers'
+    MinLengthLogitsProcessor."""
+    cfg_hf = transformers.BartConfig(
+        vocab_size=64, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64, max_position_embeddings=64,
+        pad_token_id=1, bos_token_id=0, eos_token_id=2,
+        decoder_start_token_id=2, forced_bos_token_id=None,
+        forced_eos_token_id=None,
+    )
+    torch.manual_seed(17)
+    model = transformers.BartForConditionalGeneration(cfg_hf).eval()
+    d = str(tmp_path / "minlen")
+    model.save_pretrained(d, safe_serialization=False)
+    cfg, params = bart.load_hf_dir(d, dtype="float32")
+    rng = np.random.default_rng(900)
+    src = rng.integers(4, 64, (3, 8)).astype(np.int32)
+    mask = np.ones((3, 8), dtype=np.int32)
+    for beams, ml, T in ((1, 6, 10), (4, 6, 10), (4, 9, 12)):
+        with torch.no_grad():
+            want = model.generate(
+                input_ids=torch.tensor(src, dtype=torch.long),
+                attention_mask=torch.tensor(mask, dtype=torch.long),
+                max_new_tokens=T, num_beams=beams, do_sample=False,
+                min_length=ml, length_penalty=1.0, early_stopping=False,
+            ).numpy()[:, 1:]
+        toks, _ = jax.jit(
+            lambda p, i, m, T=T, b=beams, ml=ml: bart.generate(
+                p, i, m, cfg, T, num_beams=b, min_length=ml
+            )
+        )(params, src, mask)
+        toks = np.asarray(toks)
+        n = min(want.shape[1], T)
+        np.testing.assert_array_equal(toks[:, :n], want[:, :n])
