@@ -79,11 +79,13 @@ def mesh_reduce_stats(runtime, values: Sequence[float]) -> Dict[str, Any]:
     size = _padded_len(n, dp)
     v64 = np.zeros(size, dtype=np.float64)
     v64[:n] = np.asarray(values, dtype=np.float64)
-    hi = v64.astype(np.float32)
     # Values beyond f32 range cast to ±inf; their residual would be ∓inf and
     # the recombined sum inf + -inf = NaN. Zero the residual instead so the
-    # overflow stays a detectable inf, same as a plain f32 cast.
-    with np.errstate(invalid="ignore"):
+    # overflow stays a detectable inf, same as a plain f32 cast. Both the
+    # overflowing cast and the inf arithmetic are this function's documented
+    # behavior, not accidents — silence numpy's warnings for exactly that.
+    with np.errstate(over="ignore", invalid="ignore"):
+        hi = v64.astype(np.float32)
         lo = np.where(
             np.isfinite(hi), v64 - hi.astype(np.float64), 0.0
         ).astype(np.float32)

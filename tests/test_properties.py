@@ -1,0 +1,325 @@
+"""Property-based tests (hypothesis) for the host-side data contracts.
+
+SURVEY.md §4 anticipated property-based testing for the rebuild (the driver
+``.gitignore`` reserves ``.hypothesis/``); these cover the invariants whose
+input spaces are too large to enumerate with example tests:
+
+- the quote-aware CSV byte-offset index agrees with ``csv.DictReader`` on
+  arbitrary quoted tables (embedded commas, quotes, and newlines) — this is
+  the data-distribution primitive every shard-addressed op trusts;
+- byte-tokenizer roundtrip over arbitrary Unicode;
+- padding/bucketing shape invariants behind the executable cache;
+- int8 quantization error bounds (``models/quant.py``'s scheme promises
+  elementwise error ≤ scale/2);
+- controller shard splitting partitions ``[0, total_rows)`` exactly;
+- the on-device double-single psum reduction vs exact host arithmetic.
+"""
+
+import csv
+import math
+import os
+import tempfile
+
+import numpy as np
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from agent_tpu.config import DeviceConfig
+from agent_tpu.runtime import TpuRuntime
+
+# jit compiles (bucketed, but still) and temp-file IO make per-example time
+# spiky; correctness, not speed, is under test. Applied per test (NOT via a
+# global settings profile, which would silently change hypothesis defaults
+# for every other module in the same pytest run).
+_settings = settings(
+    deadline=None,
+    max_examples=50,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+
+
+@pytest.fixture(scope="module")
+def rt():
+    return TpuRuntime(DeviceConfig())
+
+
+# ---- CSV index vs csv.DictReader ----------------------------------------
+
+# Field text may contain every character the RFC-4180 quoting story has to
+# survive: commas, double quotes, embedded newlines. '\r' is excluded — the
+# index treats bare '\n' as the row terminator (files are written that way
+# by csv.writer(lineterminator="\n")), while csv.DictReader folds a lone
+# '\r\n' *inside a field* differently per universal-newlines mode; that
+# corner is a file-format choice, not an index property.
+_field_text = st.text(
+    alphabet=st.sampled_from(list('abz09 ,"\'\n;:!')), max_size=12
+)
+
+
+@st.composite
+def _csv_tables(draw):
+    n_cols = draw(st.integers(min_value=1, max_value=4))
+    n_rows = draw(st.integers(min_value=1, max_value=25))
+    rows = [
+        [draw(_field_text) for _ in range(n_cols)] for _ in range(n_rows)
+    ]
+    return ["c%d" % i for i in range(n_cols)], rows
+
+
+@given(
+    table=_csv_tables(),
+    start=st.integers(min_value=0, max_value=30),
+    size=st.integers(min_value=1, max_value=30),
+)
+@_settings
+def test_csv_index_matches_dictreader(table, start, size):
+    """``read_shard`` == the DictReader slice for ANY quoted table: the
+    byte-offset scan (C++ or numpy — whichever the install selects) may
+    never split a quoted newline or miscount a row."""
+    from agent_tpu.data.csv_index import CsvIndex, read_shard
+
+    header, rows = table
+    fd, path = tempfile.mkstemp(suffix=".csv")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8", newline="") as f:
+            w = csv.writer(f, lineterminator="\n")
+            w.writerow(header)
+            w.writerows(rows)
+
+        with open(path, "r", encoding="utf-8", newline="") as f:
+            want_all = list(csv.DictReader(f))
+
+        index = CsvIndex.for_file(path)
+        assert index.n_data_rows == len(rows) == len(want_all)
+        assert index.header() == header
+
+        got = read_shard(path, start, size)
+        want = [dict(r) for r in want_all[start:start + size]]
+        assert got == want
+    finally:
+        os.unlink(path)
+
+
+# ---- tokenizer roundtrip + padding invariants ----------------------------
+
+
+@given(text=st.text(max_size=200))
+@_settings
+def test_byte_tokenizer_roundtrip(text):
+    from agent_tpu.models.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    # Specials are transport framing, not content: they must not leak into
+    # the decoded text.
+    framed = tok.encode(text, add_bos=True, add_eos=True)
+    assert tok.decode(framed) == text
+    assert len(framed) == len(ids) + 2
+
+
+@given(
+    seqs=st.lists(
+        st.lists(st.integers(min_value=0, max_value=259), max_size=50),
+        max_size=20,
+    ),
+    use_batch_buckets=st.booleans(),
+)
+@_settings
+def test_pad_batch_invariants(seqs, use_batch_buckets):
+    """Static-shape guarantees the executable cache depends on: bucketed
+    dims, exact masks, pad everywhere the mask is 0."""
+    from agent_tpu.models.tokenizer import (
+        DEFAULT_BUCKETS, PAD_ID, bucket_length, pad_batch,
+    )
+
+    batch_buckets = (1, 2, 4, 8, 16, 32) if use_batch_buckets else None
+    ids, mask = pad_batch(seqs, batch_buckets=batch_buckets)
+    max_len = max((len(s) for s in seqs), default=1)
+    L = bucket_length(max(1, max_len))
+    assert ids.shape == mask.shape
+    assert ids.shape[1] == L and L in DEFAULT_BUCKETS
+    if batch_buckets:
+        assert ids.shape[0] in batch_buckets and ids.shape[0] >= len(seqs)
+    else:
+        assert ids.shape[0] == len(seqs)
+    for r, s in enumerate(seqs):
+        n = min(len(s), L)
+        assert mask[r].sum() == n
+        assert list(ids[r, :n]) == list(s[:n])
+    assert np.all(ids[mask == 0] == PAD_ID)
+    assert np.all((mask == 0) | (mask == 1))
+
+
+@given(n=st.integers(min_value=1, max_value=10_000))
+@_settings
+def test_bucket_length_minimal(n):
+    from agent_tpu.models.tokenizer import DEFAULT_BUCKETS, bucket_length
+
+    b = bucket_length(n)
+    assert b in DEFAULT_BUCKETS
+    if n <= DEFAULT_BUCKETS[-1]:
+        assert b >= n
+        # minimality: no smaller bucket also covers n
+        assert all(x < n for x in DEFAULT_BUCKETS if x < b)
+    else:
+        assert b == DEFAULT_BUCKETS[-1]  # callers truncate to the top bucket
+
+
+@given(
+    n=st.integers(min_value=1, max_value=100_000),
+    multiple=st.sampled_from([1, 2, 4, 8]),
+)
+@_settings
+def test_padded_len_props(n, multiple):
+    from agent_tpu.parallel.collectives import _padded_len
+
+    size = _padded_len(n, multiple)
+    assert size >= n and size % multiple == 0
+    q = size // multiple
+    assert q & (q - 1) == 0  # power-of-two ladder
+    assert size <= max(multiple, 2 * n)  # never more than 2× overshoot
+
+
+# ---- int8 quantization error bounds --------------------------------------
+
+
+@st.composite
+def _weight_matrices(draw):
+    rows = draw(st.integers(min_value=1, max_value=8))
+    cols = draw(st.integers(min_value=1, max_value=8))
+    vals = draw(
+        st.lists(
+            st.floats(
+                min_value=-1e4, max_value=1e4,
+                allow_nan=False, allow_infinity=False, width=32,
+            ),
+            min_size=rows * cols, max_size=rows * cols,
+        )
+    )
+    return np.asarray(vals, dtype=np.float32).reshape(rows, cols)
+
+
+@given(w=_weight_matrices())
+@_settings
+def test_quantize_weight_error_bound(w):
+    """The scheme's promise: per-channel symmetric int8 with elementwise
+    reconstruction error ≤ scale/2, zeros exact, |q| ≤ 127."""
+    from agent_tpu.models.quant import quantize_weight
+
+    q = quantize_weight(w, (0,))
+    assert q["w_q"].dtype == np.int8
+    assert np.all(np.abs(q["w_q"].astype(np.int32)) <= 127)
+    assert np.all(q["w_scale"] > 0)
+    deq = q["w_q"].astype(np.float32) * q["w_scale"][None, :]
+    err = np.abs(deq - w)
+    assert np.all(err <= q["w_scale"][None, :] * 0.5 * (1 + 1e-6))
+    assert np.all(deq[w == 0.0] == 0.0)
+
+
+@given(x=_weight_matrices())
+@_settings
+def test_quantize_act_error_bound(x):
+    from agent_tpu.models.quant import quantize_act
+
+    x_q, scale = quantize_act(x)
+    x_q, scale = np.asarray(x_q), np.asarray(scale)
+    assert x_q.dtype == np.int8
+    assert np.all(np.abs(x_q.astype(np.int32)) <= 127)
+    deq = x_q.astype(np.float32) * scale
+    assert np.all(np.abs(deq - x) <= scale * 0.5 * (1 + 1e-6))
+    assert np.all(deq[x == 0.0] == 0.0)
+
+
+# ---- controller shard splitting ------------------------------------------
+
+
+@given(
+    total=st.integers(min_value=1, max_value=500),
+    size=st.integers(min_value=1, max_value=60),
+)
+@_settings
+def test_shard_split_partitions_exactly(total, size):
+    """Shards must tile [0, total_rows) with no gap, no overlap, and no
+    shard over ``shard_size`` — idempotent re-execution (SURVEY §5.4) rests
+    on this addressing."""
+    from agent_tpu.controller.core import Controller
+
+    c = Controller()
+    shard_ids, reduce_id = c.submit_csv_job(
+        "rows.csv", total_rows=total, shard_size=size, map_op="echo"
+    )
+    assert reduce_id is None
+    spans = [
+        (c._jobs[sid].payload["start_row"], c._jobs[sid].payload["shard_size"])
+        for sid in shard_ids
+    ]
+    assert spans[0][0] == 0
+    assert all(0 < n <= size for _, n in spans)
+    for (s0, n0), (s1, _) in zip(spans, spans[1:]):
+        assert s1 == s0 + n0  # contiguous, ordered, non-overlapping
+    assert spans[-1][0] + spans[-1][1] == total
+    assert sum(n for _, n in spans) == total
+
+
+# ---- map_tokenize chars mode: chunks reassemble --------------------------
+
+
+@given(
+    items=st.lists(st.text(max_size=40), min_size=1, max_size=6),
+    chunk_size=st.integers(min_value=1, max_value=16),
+)
+@_settings
+def test_map_tokenize_chars_reassembles(items, chunk_size):
+    from agent_tpu.ops import get_op
+
+    out = get_op("map_tokenize")(
+        {"items": items, "mode": "chars", "chunk_size": chunk_size}
+    )
+    assert out["ok"] is True
+    assert out["counts"] == [max(1, math.ceil(len(t) / chunk_size))
+                             for t in items]
+    # Flat chunk list partitions back into the original items.
+    chunks = out["chunks"]
+    pos = 0
+    for t, n in zip(items, out["counts"]):
+        part = chunks[pos:pos + n]
+        pos += n
+        assert "".join(part) == t
+        assert all(len(chunk) <= chunk_size for chunk in part)
+        # Every chunk but the last is full (the reference's fixed-window
+        # semantics, ref ops/map_tokenize.py:6-9).
+        assert all(len(chunk) == chunk_size for chunk in part[:-1])
+    assert pos == len(chunks)
+    assert out["total_chars"] == sum(len(t) for t in items)
+
+
+# ---- device reduction vs exact host arithmetic ---------------------------
+
+
+@given(
+    values=st.lists(
+        st.floats(
+            min_value=-1e6, max_value=1e6,
+            allow_nan=False, allow_infinity=False,
+        ),
+        min_size=1, max_size=64,
+    )
+)
+@settings(max_examples=25)  # each distinct pad bucket costs one jit compile
+def test_mesh_reduce_stats_props(rt, values):
+    """The documented numerics contract of ``mesh_reduce_stats``: sum within
+    f32 accumulation noise of exact ``math.fsum``; min/max equal to the f32
+    rounding of the exact extremes (monotonicity of rounding makes that an
+    equality, not a tolerance)."""
+    from agent_tpu.parallel.collectives import mesh_reduce_stats
+
+    out = mesh_reduce_stats(rt, values)
+    assert out["count"] == len(values)
+    want = math.fsum(values)
+    tol = max(1e-3, 1e-6 * math.fsum(abs(v) for v in values))
+    assert abs(out["sum"] - want) <= tol
+    assert out["mean"] == pytest.approx(out["sum"] / len(values))
+    assert out["min"] == float(np.float32(min(values)))
+    assert out["max"] == float(np.float32(max(values)))
