@@ -14,6 +14,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
 
 extern "C" {
 
@@ -26,8 +27,16 @@ void csv_scan_free(int64_t *p);
 }  // extern "C"
 
 namespace {
-constexpr size_t kBufSize = 1 << 20;  // 1 MiB read chunks
-}
+constexpr size_t kBufSize = 4 << 20;  // 4 MiB read chunks
+
+// The loop is memchr-driven rather than byte-at-a-time: glibc's memchr is
+// vectorized (AVX2 on this image), so hopping newline→newline scans at
+// memory bandwidth instead of ~1 byte/cycle. Quote handling keeps the same
+// RFC-4180 semantics as the scalar version (every '"' toggles state; a
+// doubled "" toggles twice, net no-op): inside quotes we hop '"'→'"'; outside
+// we cache the position of the next '"' in the chunk so quote-free data — the
+// common case — costs one extra memchr per 4 MiB, not one per row.
+}  // namespace
 
 int64_t csv_scan_offsets(const char *path, int64_t **out) {
   FILE *f = std::fopen(path, "rb");
@@ -50,11 +59,46 @@ int64_t csv_scan_offsets(const char *path, int64_t **out) {
 
   size_t got;
   while ((got = std::fread(buf, 1, kBufSize, f)) > 0) {
-    for (size_t i = 0; i < got; ++i) {
-      const unsigned char b = buf[i];
-      if (b == '"') {
-        in_quote = !in_quote;
-      } else if (b == '\n' && !in_quote) {
+    size_t i = 0;
+    // Positions of the next '"' / '\n' at or after i, or `got` if none remain
+    // in this chunk. Each is valid only while it is >= i and refreshed lazily
+    // once i passes it, so every byte of the chunk is memchr-scanned at most
+    // once per character class — quote-dense rows stay linear.
+    size_t next_q = 0, next_nl = 0;
+    bool next_q_valid = false, next_nl_valid = false;
+    while (i < got) {
+      if (in_quote) {
+        const void *q = std::memchr(buf + i, '"', got - i);
+        if (q == nullptr) {
+          i = got;  // rest of chunk is inside the quoted field
+          break;
+        }
+        i = static_cast<size_t>(static_cast<const unsigned char *>(q) - buf) + 1;
+        in_quote = false;
+        continue;  // i moved past any cached quote; the < i check refreshes
+
+      }
+      if (!next_q_valid || next_q < i) {
+        const void *q = std::memchr(buf + i, '"', got - i);
+        next_q = q == nullptr
+                     ? got
+                     : static_cast<size_t>(
+                           static_cast<const unsigned char *>(q) - buf);
+        next_q_valid = true;
+      }
+      if (!next_nl_valid || next_nl < i) {
+        const void *nl = std::memchr(buf + i, '\n', got - i);
+        next_nl = nl == nullptr
+                      ? got
+                      : static_cast<size_t>(
+                            static_cast<const unsigned char *>(nl) - buf);
+        next_nl_valid = true;
+      }
+      const size_t nl_pos = next_nl;
+      if (next_q < nl_pos) {
+        i = next_q + 1;  // now i > next_q, so the staleness check refreshes
+        in_quote = true;
+      } else if (nl_pos < got) {
         if (n == cap) {
           cap *= 2;
           int64_t *grown =
@@ -67,7 +111,10 @@ int64_t csv_scan_offsets(const char *path, int64_t **out) {
           }
           offs = grown;
         }
-        offs[n++] = pos + static_cast<int64_t>(i) + 1;
+        offs[n++] = pos + static_cast<int64_t>(nl_pos) + 1;
+        i = nl_pos + 1;
+      } else {
+        i = got;  // no newline and no quote left in this chunk
       }
     }
     pos += static_cast<int64_t>(got);
