@@ -201,6 +201,7 @@ def byte_encode_pad(
     max_len_cap: Optional[int] = None,
     add_bos: bool = False,
     add_eos: bool = False,
+    raw_uint8: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Fused byte-tokenize + pad: texts → (ids[B, L] int32, lengths[B] int32).
 
@@ -210,7 +211,17 @@ def byte_encode_pad(
     same bucketed static shapes, same truncation semantics (BOS/EOS count
     toward the cap, exactly like ``encode(add_bos, add_eos)[:cap]``). Returns
     per-row lengths (not a mask): the device path rebuilds the mask on-chip.
+
+    ``raw_uint8=True`` returns the UNSHIFTED bytes as uint8 — the minimal
+    wire format for tunnel-limited host→device links (1 byte/token instead
+    of 2): the compiled program reconstructs ``ids = (raw + N_SPECIAL) *
+    mask`` on device (see ``map_classify_tpu``), which is exact because with
+    no BOS/EOS every non-pad id is ``byte + N_SPECIAL`` and the mask already
+    distinguishes a body NUL byte (raw 0, masked in) from padding (raw 0,
+    masked out). Incompatible with ``add_bos``/``add_eos``.
     """
+    if raw_uint8 and (add_bos or add_eos):
+        raise ValueError("raw_uint8 wire cannot carry BOS/EOS specials")
     cap = max_len_cap if max_len_cap is not None else buckets[-1]
     off = int(add_bos)
     bufs = [t.encode("utf-8") for t in texts]
@@ -224,7 +235,7 @@ def byte_encode_pad(
     L = bucket_length(max(1, int(totals.max()) if rows else 1), buckets)
     totals = np.minimum(totals, L)
     B = bucket_length(max(1, rows), batch_buckets) if batch_buckets else rows
-    ids = np.zeros((B, L), dtype=np.int32)
+    ids = np.zeros((B, L), dtype=np.uint8 if raw_uint8 else np.int32)
     lengths = np.zeros(B, dtype=np.int32)
     lengths[:rows] = totals
     nb = np.zeros(B, dtype=np.int64)
@@ -233,6 +244,8 @@ def byte_encode_pad(
         nb[r] = n
         if n:
             ids[r, off : off + n] = np.frombuffer(b, dtype=np.uint8, count=n)
+    if raw_uint8:
+        return ids, lengths
     cols = np.arange(L)[None, :]
     body = (cols >= off) & (cols < off + nb[:, None])
     ids[body] += N_SPECIAL                     # every body byte, NULs included

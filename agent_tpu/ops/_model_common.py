@@ -217,32 +217,59 @@ def stage_text_chunks(
     supplies the tokenizer (e.g. a checkpoint's wordpiece vocab); the default
     is the fused byte path (``byte_encode_pad``).
 
-    Host→device traffic is the per-task tax: ship uint16 ids (vocab 260 >
-    uint8) + one length per row; the compiled program rebuilds int32 ids and
-    the [B, L] mask on device — 4× less than int32 ids + int32 mask. uint16
-    wraps ids ≥ 2^16, so it is only used while the vocab fits (a payload
-    ``model_config`` may override ``vocab_size``). Length buckets come from
-    :func:`length_buckets_for`; batch buckets are multiples of ``dp`` so the
-    batch dim always divides the mesh.
+    Host→device traffic is the per-task tax (a tunneled chip moves ~10 MB/s,
+    so wire bytes ARE serving latency): ship the narrowest exact encoding +
+    one length per row and let the compiled program rebuild int32 ids and the
+    [B, L] mask on device. Wire dtypes, narrowest first:
+
+    - uint8 **unshifted bytes** — byte-vocab path with no BOS/EOS: exact
+      reconstruction is ``(raw + N_SPECIAL) * mask`` (see
+      ``tokenizer.byte_encode_pad(raw_uint8=True)``); uint8 on this wire
+      ALWAYS means shifted-raw — real id arrays never stage as uint8.
+    - uint16 ids — any vocab < 2^16 (wordpiece/BPE/byte-with-specials).
+    - int32 ids — vocabs past 2^16 (none in-repo today).
+
+    Length buckets come from :func:`length_buckets_for`; batch buckets are
+    multiples of ``dp`` so the batch dim always divides the mesh.
     """
     import numpy as np
 
-    from agent_tpu.models.tokenizer import byte_encode_pad
+    from agent_tpu.models.tokenizer import N_SPECIAL, byte_encode_pad
 
     buckets = length_buckets_for(max_len)
     bbuckets = batch_buckets(dp, max_batch)
     wire_dtype = np.uint16 if vocab_size <= (1 << 16) else np.int32
+    custom_encode = encode_pad is not None
     if encode_pad is None:
+        # Raw-byte wire needs the byte ids 4..259 resident in the embedding
+        # table; the byte tokenizer requires that of its models anyway.
+        raw_u8 = (not add_bos and not add_eos
+                  and vocab_size >= N_SPECIAL + 256)
+
         def encode_pad(chunk, lb, bb):
             return byte_encode_pad(
                 chunk, buckets=lb, batch_buckets=bb,
                 max_len_cap=max_len, add_bos=add_bos, add_eos=add_eos,
+                raw_uint8=raw_u8,
             )
     chunks: List[Tuple] = []
     # Oversize batches run as extra device calls on the top bucket shape.
     for chunk in iter_chunks(texts, bbuckets[-1]):
         ids, lengths = encode_pad(chunk, buckets, bbuckets)
-        staged = (ids.astype(wire_dtype), lengths, len(chunk))
+        if ids.dtype == np.uint8:
+            # uint8 on this wire is an in-band sentinel meaning shifted-raw
+            # bytes; only the internal byte path above may emit it. A custom
+            # tokenizer returning uint8 real ids would be silently corrupted
+            # by the device-side (+N_SPECIAL)*mask rebuild — reject it here.
+            if custom_encode:
+                raise TypeError(
+                    "encode_pad returned uint8 ids: the uint8 wire is "
+                    "reserved for the internal raw-byte path; return "
+                    "int32/uint16 ids from custom tokenizers"
+                )
+        else:
+            ids = ids.astype(wire_dtype)
+        staged = (ids, lengths, len(chunk))
         if split_for_dispatch:
             # Dense-path dispatch budget (split_padded_chunk docstring):
             # slices dispatch back-to-back, fetched once, so the split is

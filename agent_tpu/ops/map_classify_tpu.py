@@ -257,7 +257,7 @@ def _execute_chunks(
     import jax
     import jax.numpy as jnp
 
-    from agent_tpu.models import encoder
+    from agent_tpu.models import encoder, tokenizer
     from agent_tpu.ops._model_common import cfg_key
     from agent_tpu.parallel.shardings import bert_param_specs, encoder_param_specs
 
@@ -326,19 +326,26 @@ def _execute_chunks(
         def build(L=L):
             def run_fwd(p, i, nlen):
                 mask = (jnp.arange(L)[None, :] < nlen[:, None]).astype(jnp.int32)
+                ids = i.astype(jnp.int32)
+                if i.dtype == jnp.uint8:
+                    # Raw-byte wire (stage_text_chunks): unshifted bytes on
+                    # the wire, ids rebuilt on device. Trace-time branch —
+                    # jit specializes per input dtype, so the uint16/int32
+                    # wires trace without it.
+                    ids = (ids + tokenizer.N_SPECIAL) * mask
                 if pp_mesh is not None:
                     logits = encoder_forward_pp(
-                        p, i.astype(jnp.int32), mask, cfg, pp_mesh,
+                        p, ids, mask, cfg, pp_mesh,
                         attn_fn=pp_attn,
                     )
                 elif family == "encoder":
                     logits = model_mod.forward(
-                        p, i.astype(jnp.int32), mask, cfg, attn_fn=attn_fn,
+                        p, ids, mask, cfg, attn_fn=attn_fn,
                         mesh=runtime.mesh,  # ep expert sharding for MoE cfgs
                     )
                 else:
                     logits = model_mod.forward(
-                        p, i.astype(jnp.int32), mask, cfg, attn_fn=attn_fn
+                        p, ids, mask, cfg, attn_fn=attn_fn
                     )
                 return encoder.topk_probs(logits, k)
 

@@ -642,20 +642,39 @@ def _bench_summarize(runtime, batch: int = SUMMARIZE_BATCH,
             "iters": iters, "num_beams": num_beams}
 
 
-def _bench_csv_index(tmpdir: str, n_rows: int = 200_000):
+def _bench_csv_index(tmpdir: str, n_rows: int = 1_000_000, repeats: int = 3):
+    """Index-build MB/s, best of ``repeats`` cold builds of a ~38 MB file.
+
+    The memchr scanner builds at ~1 GB/s, so the file must be big enough to
+    out-time the per-build constant costs, and best-of-N (fresh file per
+    build ⇒ every build is index-cold, page-cache warm after the first)
+    filters host-contention spikes the way the windowed legs do."""
+    import shutil
+
     from agent_tpu.data.csv_index import CsvIndex
 
-    path = os.path.join(tmpdir, "bench_rows.csv")
-    with open(path, "w") as f:
+    src = os.path.join(tmpdir, "bench_rows_0.csv")
+    with open(src, "w") as f:
         f.write("id,text,risk\n")
         for i in range(n_rows):
             f.write(f'{i},"record {i} with some text payload",{i % 97}\n')
-    size_mb = os.path.getsize(path) / 1e6
-    t0 = time.perf_counter()
-    index = CsvIndex.for_file(path)  # fresh temp file ⇒ cold index build
-    dt = time.perf_counter() - t0
-    assert index.n_data_rows == n_rows, index.n_data_rows
-    return size_mb / dt
+    best = 0.0
+    for r in range(repeats):
+        # Fresh path per repeat: CsvIndex caches by (path, size, mtime), so a
+        # copy keeps every build index-cold while the page cache stays warm.
+        path = src if r == 0 else os.path.join(tmpdir, f"bench_rows_{r}.csv")
+        if r > 0:
+            shutil.copy(src, path)
+        size_mb = os.path.getsize(path) / 1e6
+        t0 = time.perf_counter()
+        index = CsvIndex.for_file(path)  # fresh temp file ⇒ cold index build
+        dt = time.perf_counter() - t0
+        assert index.n_data_rows == n_rows, index.n_data_rows
+        if r > 0:
+            os.remove(path)
+        best = max(best, size_mb / dt)
+    os.remove(src)
+    return best
 
 
 def _drain_until_done(agent, controller, depth: int = 2) -> float:

@@ -86,6 +86,53 @@ def test_byte_encode_pad_matches_encode_plus_pad():
     )
 
 
+def test_byte_encode_pad_raw_uint8_reconstructs_exactly():
+    """The uint8 wire (unshifted bytes) must reconstruct the shifted ids via
+    (raw + N_SPECIAL) * mask — the device-side formula in map_classify_tpu —
+    including body NUL bytes, empty rows, and truncated rows."""
+    import numpy as np
+
+    import pytest
+
+    from agent_tpu.models.tokenizer import N_SPECIAL, byte_encode_pad
+
+    texts = ["hello world", "ünïcödé £ text", "", "a" * 300, "nul\x00byte"]
+    kw = dict(buckets=[16, 64, 128], batch_buckets=[8], max_len_cap=128)
+    want_ids, want_lengths = byte_encode_pad(texts, **kw)
+    raw, lengths = byte_encode_pad(texts, raw_uint8=True, **kw)
+    assert raw.dtype == np.uint8
+    np.testing.assert_array_equal(lengths, want_lengths)
+    L = raw.shape[1]
+    mask = (np.arange(L)[None, :] < lengths[:, None]).astype(np.int32)
+    np.testing.assert_array_equal((raw.astype(np.int32) + N_SPECIAL) * mask,
+                                  want_ids)
+    with pytest.raises(ValueError):
+        byte_encode_pad(texts, raw_uint8=True, add_eos=True, **kw)
+
+
+def test_stage_text_chunks_byte_path_ships_uint8():
+    """The classify byte path stages the uint8 raw wire; BOS/EOS staging
+    (summarize) and small-vocab configs stay on the uint16 id wire."""
+    import numpy as np
+
+    from agent_tpu.ops._model_common import stage_text_chunks
+
+    chunks = stage_text_chunks(
+        1, ["alpha", "beta"], max_len=128, vocab_size=260, max_batch=8
+    )
+    assert all(ids.dtype == np.uint8 for ids, _, _ in chunks)
+    chunks = stage_text_chunks(
+        1, ["alpha", "beta"], max_len=128, vocab_size=260, max_batch=8,
+        add_bos=True, add_eos=True,
+    )
+    assert all(ids.dtype == np.uint16 for ids, _, _ in chunks)
+    # vocab too small to hold all byte ids: raw wire must not engage
+    chunks = stage_text_chunks(
+        1, ["alpha"], max_len=128, vocab_size=100, max_batch=8
+    )
+    assert all(ids.dtype == np.uint16 for ids, _, _ in chunks)
+
+
 def test_byte_encode_pad_bos_eos_matches_encode_plus_pad():
     """BOS/EOS semantics must match encode(add_bos, add_eos)[:cap] exactly,
     including the EOS lost to truncation at the cap boundary."""
