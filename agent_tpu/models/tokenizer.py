@@ -239,11 +239,23 @@ def byte_encode_pad(
     lengths = np.zeros(B, dtype=np.int32)
     lengths[:rows] = totals
     nb = np.zeros(B, dtype=np.int64)
-    for r, b in enumerate(bufs):
-        n = min(len(b), int(totals[r]) - off) if totals[r] > off else 0
-        nb[r] = n
-        if n:
-            ids[r, off : off + n] = np.frombuffer(b, dtype=np.uint8, count=n)
+    nb[:rows] = np.maximum(totals - off, 0)
+    nb[:rows] = np.minimum(nb[:rows], lens)
+    if rows:
+        # One vectorized scatter instead of a per-row copy loop: all texts
+        # join into one flat byte view, and each row r pulls its
+        # flat[start_r : start_r + nb_r] slice via a masked gather — ~3
+        # array passes over [B, L] (a few ms at 8k×128) vs 8k Python
+        # iterations.
+        flat = np.frombuffer(b"".join(bufs), dtype=np.uint8)
+        starts = np.zeros(rows, dtype=np.int64)
+        if rows > 1:
+            np.cumsum(lens[:-1], out=starts[1:])
+        cols = np.arange(L, dtype=np.int64)[None, :]
+        body = (cols >= off) & (cols < off + nb[:rows, None])
+        src = starts[:, None] + (cols - off)
+        if flat.size:
+            ids[:rows][body] = flat[np.clip(src, 0, flat.size - 1)][body]
     if raw_uint8:
         return ids, lengths
     cols = np.arange(L)[None, :]

@@ -347,7 +347,15 @@ def _execute_chunks(
                     logits = model_mod.forward(
                         p, ids, mask, cfg, attn_fn=attn_fn
                     )
-                return encoder.topk_probs(logits, k)
+                vals, idx = encoder.topk_probs(logits, k)
+                # One fused [B, k, 2] f32 result: a device→host read costs a
+                # full round trip regardless of size (tunneled hosts measure
+                # ~60 ms each), so vals+idx must fetch as ONE array. idx
+                # rides as its exact int32 bitpattern, no magnitude limit.
+                return jnp.stack(
+                    [vals, jax.lax.bitcast_convert_type(idx, jnp.float32)],
+                    axis=-1,
+                )
 
             return jax.jit(run_fwd)
 
@@ -360,22 +368,19 @@ def _execute_chunks(
             ("map_classify_tpu", model_id, family, B, L, k, cfg_key(cfg)),
             build,
         )
-        vals, idx = fn(
+        packed = fn(
             params, runtime.put_batch(ids), runtime.put_batch(lengths)
         )
-        pending.append((vals, idx, n))
+        pending.append((packed, n))
     if len(pending) > 1:
         # Gather the chunk results on DEVICE here, on the dispatching
         # (owner) thread: each host read of a device array is a full tunnel
-        # round trip, so fetching 16 chunks separately would pay 32 round
-        # trips where two suffice — and in pipelined no-fallback mode the
+        # round trip, so fetching 16 chunks separately would pay 16 round
+        # trips where one suffices — and in pipelined no-fallback mode the
         # fetch happens on the poster thread, which must only ever READ
         # device arrays (single-owner dispatch invariant, agent/pipeline.py).
-        vals_d, idx_d = _concat_pending()(
-            [v for v, _, _ in pending], [i for _, i, _ in pending]
-        )
-        pending = [("cat", vals_d, idx_d,
-                    [(v.shape[0], n) for v, _, n in pending])]
+        packed_d = _concat_pending()([p for p, _ in pending])
+        pending = [("cat", packed_d, [(p.shape[0], n) for p, n in pending])]
     if not fetch:
         return pending
     return _fetch_pending(pending)
@@ -393,32 +398,33 @@ def _concat_pending():
         import jax
         import jax.numpy as jnp
 
-        _concat_fn = jax.jit(
-            lambda vs, idxs: (
-                jnp.concatenate(vs, axis=0),
-                jnp.concatenate(idxs, axis=0),
-            )
-        )
+        _concat_fn = jax.jit(lambda ps: jnp.concatenate(ps, axis=0))
     return _concat_fn
 
 
 def _fetch_pending(pending) -> Tuple[np.ndarray, np.ndarray]:
     """Sync pending device results → (vals [N, k], idx [N, k]) numpy,
-    trimming padding rows. Pure READS of device arrays (np.asarray), so the
-    pipelined poster thread may call it: multi-chunk shards were already
-    gathered into one ``("cat", vals, idx, layout)`` entry on the device
-    thread at dispatch time."""
-    if pending and len(pending[0]) == 4:  # ("cat", vals, idx, layout)
-        _, vals_d, idx_d, layout = pending[0]
-        vals, idx = np.asarray(vals_d), np.asarray(idx_d)
-        out_v, out_i, off = [], [], 0
+    trimming padding rows — ONE ``np.asarray`` (= one device→host round
+    trip) per shard: chunks return a packed [B, k, 2] array (scores, idx
+    bitcast to f32) and multi-chunk shards were already gathered into one
+    ``("cat", packed, layout)`` entry on the device thread at dispatch time.
+    Pure READS of device arrays, so the pipelined poster thread may call
+    it."""
+    first = pending[0]
+    if isinstance(first[0], str):  # ("cat", packed, layout)
+        _, packed_d, layout = first
+        arr = np.asarray(packed_d)
+        out, off = [], 0
         for B, n in layout:
-            out_v.append(vals[off:off + n])
-            out_i.append(idx[off:off + n])
+            out.append(arr[off:off + n])
             off += B
-        return np.concatenate(out_v), np.concatenate(out_i)
-    v, i, n = pending[0]
-    return np.asarray(v)[:n], np.asarray(i)[:n]
+        arr = np.concatenate(out)
+    else:  # (packed, n)
+        packed_d, n = first
+        arr = np.asarray(packed_d)[:n]
+    vals = np.ascontiguousarray(arr[..., 0])
+    idx = np.ascontiguousarray(arr[..., 1]).view(np.int32)
+    return vals, idx
 
 
 def _get_cpu_runtime():
