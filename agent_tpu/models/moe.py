@@ -165,12 +165,21 @@ def moe_ffn(params: Params, x: jax.Array, cfg: MoeConfig,
     expert_in = constrain(jnp.einsum(
         "gtec,gtd->gecd", dispatch.astype(dtype), xg.astype(dtype)
     ))                                                               # [g, E, C, d]
-    h = jax.nn.gelu(jnp.einsum(
-        "gecd,edf->gecf", expert_in, params["wi"].astype(dtype)
-    ))
-    expert_out = constrain(jnp.einsum(
-        "gecf,efd->gecd", h, params["wo"].astype(dtype)
-    ))
+    from agent_tpu.models import quant
+
+    if quant.is_quantized(params["wi"]):
+        # int8 expert FFN (quant.qmoe_expert): same W8A8 recipe as the dense
+        # families, per-expert weight scales; router/dispatch/combine stay
+        # high-precision.
+        h = jax.nn.gelu(quant.qmoe_expert(params["wi"], expert_in, dtype))
+        expert_out = constrain(quant.qmoe_expert(params["wo"], h, dtype))
+    else:
+        h = jax.nn.gelu(jnp.einsum(
+            "gecd,edf->gecf", expert_in, params["wi"].astype(dtype)
+        ))
+        expert_out = constrain(jnp.einsum(
+            "gecf,efd->gecd", h, params["wo"].astype(dtype)
+        ))
     y = jnp.einsum("gtec,gecd->gtd", combine.astype(dtype), expert_out)
     y = y.reshape(n_g * group, d)[:T]
 

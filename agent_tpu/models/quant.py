@@ -177,6 +177,26 @@ def qproj_out(p: Params, x: jax.Array, dtype: Any) -> jax.Array:
     return y.astype(dtype)
 
 
+def qmoe_expert(p: Params, x: jax.Array, dtype: Any) -> jax.Array:
+    """int8 path of the grouped expert matmul (``models.moe``):
+    x [G, E, C, d_in] @ w [E, d_in, d_out] → [G, E, C, d_out] (the
+    ``gecd,edf->gecf`` / ``gecf,efd->gecd`` einsums, expert dim batched).
+
+    Per-slot dynamic activation scales (each [g, e, c] capacity row
+    quantizes over its feature axis) and per-expert-per-channel weight
+    scales (``quantize_weight(w, (1,))`` → [E, d_out]), so each expert's
+    matmul is the same W8A8 recipe as :func:`qdense`. Capacity-padding rows
+    are all-zero → scale floors at ``_EPS`` → exact zeros, same as dense."""
+    x_q, sx = quantize_act(x)                       # sx [G, E, C, 1]
+    y = lax.dot_general(
+        x_q, p["w_q"],
+        (((3,), (1,)), ((1,), (0,))),               # contract d; batch E
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.float32)                           # [E, G, C, d_out]
+    y = y.transpose(1, 0, 2, 3) * (sx * p["w_scale"][None, :, None, :])
+    return y.astype(dtype)
+
+
 # ---- family param-tree transformers (+ matching spec transformers) ----
 #
 # Each quantize_* below has a *_specs twin transforming the same paths of the
@@ -217,10 +237,22 @@ def _quantize_attn_specs(a: Params) -> Params:
 def _quantize_block(b: Params) -> Params:
     nb = dict(b)
     nb["attn"] = _quantize_attn(b["attn"])
-    nb["ffn"] = {
-        "wi": quantize_dense(b["ffn"]["wi"]),
-        "wo": quantize_dense(b["ffn"]["wo"]),
-    }
+    if "ffn" in b:
+        nb["ffn"] = {
+            "wi": quantize_dense(b["ffn"]["wi"]),
+            "wo": quantize_dense(b["ffn"]["wo"]),
+        }
+    if "moe" in b:
+        # Switch MoE FFN: expert-stacked weights take per-expert-per-channel
+        # int8 (scale over each expert's contracting dim); the router stays
+        # f32 — it is tiny and its softmax/argmax routing decisions are
+        # dynamic-range-fragile (same exclusion rule as attention scores).
+        m = b["moe"]
+        nb["moe"] = {
+            "router": m["router"],
+            "wi": quantize_weight(m["wi"], (1,)),
+            "wo": quantize_weight(m["wo"], (1,)),
+        }
     if "xattn" in b:
         nb["xattn"] = _quantize_attn(b["xattn"])
     return nb
@@ -229,10 +261,18 @@ def _quantize_block(b: Params) -> Params:
 def _quantize_block_specs(b: Params) -> Params:
     nb = dict(b)
     nb["attn"] = _quantize_attn_specs(b["attn"])
-    nb["ffn"] = {
-        "wi": _qdense_spec(b["ffn"]["wi"]),
-        "wo": _qdense_spec(b["ffn"]["wo"]),
-    }
+    if "ffn" in b:
+        nb["ffn"] = {
+            "wi": _qdense_spec(b["ffn"]["wi"]),
+            "wo": _qdense_spec(b["ffn"]["wo"]),
+        }
+    if "moe" in b:
+        m = b["moe"]
+        nb["moe"] = {
+            "router": m["router"],
+            "wi": _qw_spec(m["wi"], (1,)),   # scale [E, d_out] → P("ep", ·)
+            "wo": _qw_spec(m["wo"], (1,)),
+        }
     if "xattn" in b:
         nb["xattn"] = _quantize_attn_specs(b["xattn"])
     return nb

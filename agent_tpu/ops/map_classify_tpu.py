@@ -490,13 +490,16 @@ def stage(payload: Any, ctx: Optional[object] = None):
             # cfg.pp would let the mesh-axis route bypass every check.
             mesh_pp = rt.axis_size("pp") if rt is not None else 1
             eff_pp = mesh_pp if mesh_pp > 1 else getattr(cfg, "pp", 1)
+            # (int8 composes with BOTH pp and MoE since round 5: quantized
+            # leaves are ordinary pytrees for the GPipe stack/scan, and MoE
+            # expert FFNs take per-expert int8 — quant.qmoe_expert. The
+            # former soft-rejections are now equality-tested serving modes,
+            # tests/test_pp_moe_serving.py.)
             if eff_pp > 1:
                 if cfg.n_layers % eff_pp != 0:
                     raise ValueError(
                         f"n_layers {cfg.n_layers} not divisible by pp={eff_pp}"
                     )
-                if cfg.quant == "int8":
-                    raise ValueError("pp serving does not support quant=int8")
                 if cfg.moe_experts > 0:
                     raise ValueError(
                         "pp and moe_experts cannot combine in one config"
@@ -507,8 +510,6 @@ def stage(payload: Any, ctx: Optional[object] = None):
                         f"pp={eff_pp} does not divide the "
                         f"{rt.n_devices}-device mesh"
                     )
-            if cfg.moe_experts > 0 and cfg.quant == "int8":
-                raise ValueError("MoE serving does not support quant=int8")
         items, kind, single = _collect_sequences(payload, cfg)
         from agent_tpu.ops._model_common import (
             validate_output_uri,

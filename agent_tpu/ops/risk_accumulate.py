@@ -184,13 +184,30 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         )
         return stats
 
-    total = math.fsum(values)
+    try:
+        total = math.fsum(values)
+    except ValueError:
+        # fsum RAISES on mixed infinities ("-inf + inf in fsum") where IEEE
+        # arithmetic — and the device path — yields NaN; a valid payload
+        # must not crash the op.
+        total = float("nan")
+    # A NaN INPUT poisons min/max as well as the sum: Python ``min``/``max``
+    # are order-DEPENDENT under NaN (min([nan, 1]) = nan, min([1, nan]) = 1),
+    # and the device path (``mesh_reduce_stats``) canonicalizes the same way,
+    # so both paths return identical results for NaN-carrying shards. (An
+    # inf + -inf sum is NaN too, but min/max stay well-defined there — the
+    # gate is on the inputs, not the total.)
+    nan_in = any(math.isnan(v) for v in values)
+    mn, mx = (
+        (float("nan"), float("nan")) if nan_in
+        else (min(values), max(values))
+    )
     return {
         "ok": True,
         "count": len(values),
         "sum": total,
         "mean": total / len(values),
-        "min": min(values),
-        "max": max(values),
+        "min": mn,
+        "max": mx,
         "compute_time_ms": (time.perf_counter() - t0) * 1000.0,
     }

@@ -43,9 +43,25 @@ def _build_stats_fn(runtime) -> Any:
         # combine on the host in f64 (see mesh_reduce_stats).
         s_hi = lax.psum(jnp.sum(hi * m), "dp")
         s_lo = lax.psum(jnp.sum(lo * m), "dp")
-        mn = lax.pmin(jnp.min(jnp.where(m > 0, hi, jnp.inf)), "dp")
-        mx = lax.pmax(jnp.max(jnp.where(m > 0, hi, -jnp.inf)), "dp")
-        return s_hi, s_lo, mn, mx
+        # min/max via monotone bitcast keys, reduced as *integers*.  A float
+        # pmin/pmax on the VPU flushes subnormal inputs to zero (FTZ), which
+        # broke the exact-f32 contract for inputs like 1.4e-45 (round-4
+        # Hypothesis counterexample).  The IEEE-754 sign-magnitude encoding
+        # admits a monotone map to uint32 — key = bits ^ (0x80000000 for
+        # positives, 0xFFFFFFFF for negatives) — so integer reductions order
+        # floats exactly, subnormals included: bitcast, xor, and integer
+        # min/max never touch the float datapath, so nothing can flush.
+        bits = lax.bitcast_convert_type(hi, jnp.uint32)
+        key = bits ^ jnp.where(
+            (bits >> 31) != 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0x80000000)
+        )
+        # Pad sentinels: 0xFFFFFFFF is the largest key (above +inf's), 0 the
+        # smallest (below -inf's); n ≥ 1 guarantees a real element survives.
+        k_mn = lax.pmin(
+            jnp.min(jnp.where(m > 0, key, jnp.uint32(0xFFFFFFFF))), "dp"
+        )
+        k_mx = lax.pmax(jnp.max(jnp.where(m > 0, key, jnp.uint32(0))), "dp")
+        return s_hi, s_lo, k_mn, k_mx
 
     fn = jax.shard_map(
         local_stats,
@@ -66,15 +82,29 @@ def mesh_reduce_stats(runtime, values: Sequence[float]) -> Dict[str, Any]:
     there is NO input-cast error vs the host ``math.fsum`` path for the
     **sum** (the residual is f32 *accumulation* error of the shard-local
     sums, worst-case relative ``n · 2⁻²⁴`` and in practice far smaller — XLA
-    reduces in trees). **min/max are computed over the hi component only**,
-    so they can differ from the exact f64 host path by one f32 rounding ulp
-    of the extreme value. The controller-side merge path stays exact
-    (``risk_accumulate`` host fsum); this device path trades that last-ulp
-    exactness for on-chip reduction over ICI.
+    reduces in trees). **min/max equal the f32 rounding of the exact f64
+    extremes — an equality, not a tolerance, subnormals included**: rounding
+    is monotone, so ``min(round(v)) == round(min(v))``, and the reduction
+    runs over monotone bitcast integer keys (see ``_build_stats_fn``) so the
+    device's flush-to-zero float mode cannot perturb it. The controller-side
+    merge path stays exact (``risk_accumulate`` host fsum); the sum here
+    trades the last-ulp accumulation exactness for on-chip reduction over
+    ICI.
     """
     n = len(values)
     if n == 0:
         return {"count": 0, "sum": 0.0, "mean": 0.0, "min": None, "max": None}
+    if np.isnan(values).any():
+        # NaN poisons every statistic, deterministically. Without this check
+        # the bitcast-key reduce would apply IEEE total-order semantics
+        # (negative NaN < -inf, positive NaN > +inf) — order-independent but
+        # asymmetric (min skips a positive NaN that max returns) — and the
+        # host path's Python ``min``/``max`` are order-DEPENDENT under NaN,
+        # so neither is a contract worth matching. ``fsum`` already yields
+        # NaN for the sum; min/max follow it. (Same canonicalization in the
+        # ``risk_accumulate`` host path.)
+        nan = float("nan")
+        return {"count": n, "sum": nan, "mean": nan, "min": nan, "max": nan}
     dp = runtime.axis_size("dp")
     size = _padded_len(n, dp)
     v64 = np.zeros(size, dtype=np.float64)
@@ -96,7 +126,7 @@ def mesh_reduce_stats(runtime, values: Sequence[float]) -> Dict[str, Any]:
         ("mesh_reduce_stats", size, dp), lambda: _build_stats_fn(runtime)
     )
     sharding = runtime.sharding("dp")
-    s_hi, s_lo, mn, mx = fn(
+    s_hi, s_lo, k_mn, k_mx = fn(
         jax.device_put(hi, sharding),
         jax.device_put(lo, sharding),
         jax.device_put(m, sharding),
@@ -109,6 +139,13 @@ def mesh_reduce_stats(runtime, values: Sequence[float]) -> Dict[str, Any]:
         "count": n,
         "sum": total,
         "mean": total / n,
-        "min": float(mn),
-        "max": float(mx),
+        "min": _key_to_f32(int(k_mn)),
+        "max": _key_to_f32(int(k_mx)),
     }
+
+
+def _key_to_f32(key: int) -> float:
+    """Invert the monotone uint32 order key back to its f32 value (host side,
+    pure integer ops — the device never reconstructs the float)."""
+    bits = key ^ (0x80000000 if key & 0x80000000 else 0xFFFFFFFF)
+    return float(np.uint32(bits).view(np.float32))

@@ -37,6 +37,52 @@ def test_mesh_reduce_single_value(rt):
     assert out == {"count": 1, "sum": 3.25, "mean": 3.25, "min": 3.25, "max": 3.25}
 
 
+def test_mesh_reduce_subnormal_exact(rt):
+    """Round-4 Hypothesis counterexample pinned: subnormal f32 inputs must
+    NOT flush to zero in min/max (the reduce runs over monotone bitcast
+    integer keys, immune to the device's FTZ float mode)."""
+    tiny = 1.401298464324817e-45  # smallest positive f32 subnormal
+    out = mesh_reduce_stats(rt, [tiny])
+    assert out["min"] == tiny and out["max"] == tiny
+    out = mesh_reduce_stats(rt, [-tiny, 0.0, tiny])
+    assert out["min"] == -tiny and out["max"] == tiny
+
+
+def test_mesh_reduce_nan_poisons_all_stats(rt):
+    """A NaN input deterministically poisons sum/mean/min/max — in any
+    position (Python min/max would be order-dependent; the bitcast-key
+    reduce would be asymmetric). Count stays exact host knowledge."""
+    for values in ([1.0, float("nan"), 5.0], [float("nan")],
+                   [5.0, 1.0, float("nan")]):
+        out = mesh_reduce_stats(rt, values)
+        assert out["count"] == len(values)
+        assert all(
+            math.isnan(out[k]) for k in ("sum", "mean", "min", "max")
+        ), out
+
+
+def test_mesh_reduce_inf_keeps_minmax_defined(rt):
+    """inf + -inf sums to NaN, but min/max stay the exact extremes — the
+    NaN gate is on the inputs, not the total."""
+    out = mesh_reduce_stats(rt, [float("inf"), float("-inf"), 2.0])
+    assert out["min"] == float("-inf") and out["max"] == float("inf")
+
+
+def test_risk_accumulate_host_nan_matches_device_semantics():
+    """The host path (small payloads) canonicalizes NaN the same way, in
+    any input order."""
+    from agent_tpu.ops.risk_accumulate import run
+
+    for values in ([float("nan"), 1.0], [1.0, float("nan")]):
+        out = run({"values": values})
+        assert out["ok"] is True and out["count"] == 2
+        assert all(
+            math.isnan(out[k]) for k in ("sum", "mean", "min", "max")
+        ), out
+    out = run({"values": [float("inf"), float("-inf")]})
+    assert out["min"] == float("-inf") and out["max"] == float("inf")
+
+
 def test_mesh_reduce_reuses_executable(rt):
     before = rt.cache.stats()["misses"]
     mesh_reduce_stats(rt, list(np.arange(50, dtype=np.float64)))

@@ -22,7 +22,7 @@ import tempfile
 
 import numpy as np
 import pytest
-from hypothesis import HealthCheck, given, settings
+from hypothesis import HealthCheck, example, given, settings
 from hypothesis import strategies as st
 
 from agent_tpu.config import DeviceConfig
@@ -307,12 +307,16 @@ def test_map_tokenize_chars_reassembles(items, chunk_size):
         min_size=1, max_size=64,
     )
 )
+@example(values=[1.401298464324817e-45])  # round-4 counterexample: subnormal
+# f32 was flushed to zero by the device float min/max; now reduced as
+# monotone bitcast integer keys (collectives._build_stats_fn), immune to FTZ.
+@example(values=[-1.401298464324817e-45, 1e-40, -0.0])
 @settings(max_examples=25)  # each distinct pad bucket costs one jit compile
 def test_mesh_reduce_stats_props(rt, values):
     """The documented numerics contract of ``mesh_reduce_stats``: sum within
     f32 accumulation noise of exact ``math.fsum``; min/max equal to the f32
     rounding of the exact extremes (monotonicity of rounding makes that an
-    equality, not a tolerance)."""
+    equality, not a tolerance — subnormals included)."""
     from agent_tpu.parallel.collectives import mesh_reduce_stats
 
     out = mesh_reduce_stats(rt, values)
