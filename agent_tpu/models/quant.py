@@ -58,6 +58,12 @@ def is_quantized(leaf: Any) -> bool:
     return isinstance(leaf, dict) and "w_q" in leaf
 
 
+def is_weight_only(leaf: Any) -> bool:
+    """W8A16 leaf (``{"w8": int8, "w_scale"}``): int8 weight table, but
+    activations stay in the compute dtype — no dynamic quantization."""
+    return isinstance(leaf, dict) and "w8" in leaf
+
+
 # ---- weight quantization (host, build-time) ----
 
 
@@ -174,6 +180,61 @@ def qproj_out(p: Params, x: jax.Array, dtype: Any) -> jax.Array:
         preferred_element_type=jnp.int32,
     ).astype(jnp.float32)                           # [B, L, d]
     y = y * (sx[..., 0] * p["w_scale"])
+    return y.astype(dtype)
+
+
+# ---- weight-only (W8A16) matmuls ----
+#
+# The memory-bound recipe for DECODE: the per-step matmuls are [rows, d]-thin
+# (rows ≤ batch, d = d_model), so the MXU is idle waiting on HBM and the
+# W8A8 activation-quant overhead buys nothing (measured: 3,983 int8 vs
+# 4,980 bf16 rows/s at B=1024 — bench.py decode note). Weight-only keeps
+# activations in the compute dtype and ships/reads the int8 table (half the
+# bf16 bytes, a quarter of f32), dequantizing by a per-output-channel scale
+# on the dot's OUTPUT — the epilogue fuses, and there is no quantize pass
+# at all. Same int8 tables as W8A8 (quantize_weight), different execution.
+
+
+def wdense(p: Params, x: jax.Array, dtype: Any) -> jax.Array:
+    """W8A16 path of ``layers.dense``: x [..., in] @ w8 [in, out] + b."""
+    y = jnp.dot(x.astype(dtype), p["w8"].astype(dtype))
+    y = y.astype(jnp.float32) * p["w_scale"]
+    if "b" in p:
+        y = y + p["b"]
+    return y.astype(dtype)
+
+
+def wproj_in(p: Params, x: jax.Array, dtype: Any) -> jax.Array:
+    """W8A16 path of the head-axis input projection:
+    x [B, L, d] @ w8 [d, H, E] → [B, H, L, E]."""
+    y = lax.dot_general(
+        x.astype(dtype), p["w8"].astype(dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+    )                                               # [B, L, H, E]
+    y = (y.astype(jnp.float32) * p["w_scale"][None, None]).astype(dtype)
+    return y.transpose(0, 2, 1, 3)
+
+
+def wproj_out(p: Params, x: jax.Array, dtype: Any) -> jax.Array:
+    """W8A16 path of the head-axis output projection:
+    x [B, H, L, E] @ w8 [H, E, d] → [B, L, d]."""
+    xt = x.transpose(0, 2, 1, 3)                    # [B, L, H, E]
+    y = lax.dot_general(
+        xt, p["w8"].astype(dtype),
+        (((2, 3), (0, 1)), ((), ())),
+    )                                               # [B, L, d]
+    return (y.astype(jnp.float32) * p["w_scale"]).astype(dtype)
+
+
+def wmoe_expert(p: Params, x: jax.Array, dtype: Any) -> jax.Array:
+    """W8A16 path of the grouped expert matmul (layout as
+    :func:`qmoe_expert`): x [G, E, C, d_in] @ w8 [E, d_in, d_out]."""
+    y = lax.dot_general(
+        x.astype(dtype), p["w8"].astype(dtype),
+        (((3,), (1,)), ((1,), (0,))),               # contract d; batch E
+    )                                               # [E, G, C, d_out]
+    y = y.transpose(1, 0, 2, 3).astype(jnp.float32) \
+        * p["w_scale"][None, :, None, :]
     return y.astype(dtype)
 
 
