@@ -149,6 +149,13 @@ class DeviceConfig:
     mesh_shape: Dict[str, int] = field(default_factory=dict)
     # Dtype for model compute on device; bf16 is the MXU-native choice.
     compute_dtype: str = "bfloat16"
+    # Fleet-default quantized execution mode (TPU_QUANT): "" = unset (serve
+    # each model config's own default), "none"/"int8"/"w8a16" otherwise.
+    # The op-level precedence (payload model_config.quant > env > config
+    # default) and the strict fail-the-shard validation of a bad env value
+    # live in ops/_model_common.apply_quant_env; this field is the typed,
+    # read-once view for telemetry (runtime.describe) and operators.
+    quant: str = ""
     # Persistent XLA compilation cache directory ("" disables).
     compile_cache_dir: str = ""
     # Fused Pallas attention kernel on TPU (PALLAS_ATTN=0 falls back to the
@@ -186,6 +193,7 @@ class DeviceConfig:
             tpu_type=os.environ.get("TPU_TYPE") or None,
             mesh_shape=mesh,
             compute_dtype=env_str("COMPUTE_DTYPE", "bfloat16"),
+            quant=env_str("TPU_QUANT", "").strip().lower(),
             compile_cache_dir=env_str("JAX_COMPILATION_CACHE_DIR", ""),
             pallas_attn=env_bool("PALLAS_ATTN", True),
             coordinator_address=os.environ.get("COORDINATOR_ADDRESS") or None,

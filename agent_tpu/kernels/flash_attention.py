@@ -32,6 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from agent_tpu.models.layers import NEG_INF, dot_product_attention
+from agent_tpu.utils.compat import shape_dtype_struct, shard_map
 
 _LANES = 128  # VPU lane width; scratch last dims pad to this anyway
 
@@ -44,9 +45,9 @@ _LANES = 128  # VPU lane width; scratch last dims pad to this anyway
 # is real once the dense path's [Lq, Lk] score materialization dominates.
 # Measured per-call ratios vs the CURRENT dense path (which stores scores
 # in bf16 — that change roughly doubled dense speed and honestly shrank
-# these ratios from the old f32-score era's 3.7×/50×): 1.33× at 4k,
-# 1.94× at 8k, d_head 128 (driver artifact `flash_vs_dense[_8k]`,
-# BENCH_r04). The kernel's bigger win at long context is MEMORY — no
+# these ratios from the old f32-score era's 3.7×/50×): 1.76× at 4k,
+# 2.21× at 8k, d_head 128 (driver artifact `flash_vs_dense[_8k]`,
+# BENCH_r05). The kernel's bigger win at long context is MEMORY — no
 # [L, L] score tensor in HBM, so batch/length scale past where dense
 # OOMs. Hence the 2048 gate; trust model-level numbers over kernel
 # microbenchmarks when moving it.
@@ -336,9 +337,9 @@ def flash_fold(q, k, v, mask, m, l, acc, *, block_q: int = 512,
         ],
         out_specs=(sspec, sspec, qspec),
         out_shape=(
-            jax.ShapeDtypeStruct(m.shape, jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct(l.shape, jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct(acc.shape, jnp.float32, vma=vma),
+            shape_dtype_struct(m.shape, jnp.float32, vma=vma),
+            shape_dtype_struct(l.shape, jnp.float32, vma=vma),
+            shape_dtype_struct(acc.shape, jnp.float32, vma=vma),
         ),
         scratch_shapes=[
             pltpu.VMEM((bq, _LANES), jnp.float32),
@@ -551,7 +552,7 @@ def make_flash_attention_t5(mesh):
             min_key_len=0,  # validated above, on the GLOBAL shapes
             interpret=interpret,
         )
-        sharded = jax.shard_map(
+        sharded = shard_map(
             inner,
             mesh=mesh,
             in_specs=(
@@ -929,7 +930,7 @@ def _make_mesh_wrapper(mesh, inner, dense_counter_key: Optional[str]):
     dp = shape.get("dp", 1)
     tp = shape.get("tp", 1)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         inner,
         mesh=mesh,
         in_specs=(

@@ -49,7 +49,9 @@ class BartConfig:
     forced_eos_id: Optional[int] = 2  # HF BART forces EOS at max length
     scale_embedding: bool = False
     dtype: str = "bfloat16"
-    # "int8": serve with W8A8 quantized matmuls (models.quant).
+    # "int8": serve with W8A8 quantized matmuls (models.quant); "w8a16":
+    # weight-only int8 — the decode-mode recipe (int8-resident weights
+    # dequantized in-register, activations stay at dtype).
     quant: str = "none"
 
     # Uniform serving-config view (map_summarize reads these off any family).

@@ -52,7 +52,7 @@ class BertConfig:
     dtype: str = "bfloat16"
     # "int8": serve with W8A8 quantized matmuls (models.quant) — execution
     # mode, not a different artifact; the checkpoint weights are quantized
-    # per-channel at load.
+    # per-channel at load. "w8a16": weight-only int8, activations at dtype.
     quant: str = "none"
 
     # Uniform serving-config view (the classify op reads these off any family).

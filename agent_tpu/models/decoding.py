@@ -134,6 +134,7 @@ def beam_scan(
     min_length: int = 0,
     forced_first_id: Optional[int] = None,
     forced_last_id: Optional[int] = None,
+    cache_reorder: str = "delta",
 ) -> Tuple[jax.Array, jax.Array]:
     """Beam-search decode → (tokens [B, T], lengths [B]); static shapes.
 
@@ -156,7 +157,24 @@ def beam_scan(
     Beams flatten into the batch dim, so the model's step executable is
     shared with greedy at ``B*K`` rows. ``num_beams=1`` degenerates to
     greedy-with-banking: same emitted tokens as ``greedy_scan``.
+
+    ``cache_reorder`` picks the KV-cache beam-reorder scheme, bit-identical
+    outputs either way (regression-tested):
+
+    - ``"delta"`` (default): the per-step gather of every KV cache along the
+      beam axis runs under ``lax.cond``, skipped entirely on steps where the
+      selected continuation is the identity permutation (each beam extends
+      its own parent — ``beam_idx == arange(K)`` for every row, the common
+      case once beam frontiers stabilize and for frozen rows). The gather
+      moves the FULL [B·K, H, T, D] cache per layer; skipping identity steps
+      removes that HBM round trip from most of a long decode.
+    - ``"gather"``: the unconditional per-step gather (the pre-delta
+      behavior), kept as the equivalence-test reference.
     """
+    if cache_reorder not in ("delta", "gather"):
+        raise ValueError(
+            f"cache_reorder must be 'delta' or 'gather', got {cache_reorder!r}"
+        )
     B, K, V, T = batch, num_beams, vocab_size, max_new_tokens
     K2 = 2 * K
     tok0 = jnp.full((B * K,), start_id, dtype=jnp.int32)
@@ -242,10 +260,16 @@ def beam_scan(
         new_tok = jnp.take_along_axis(cand_tok, gather_pos, axis=1)
         beam_idx = jnp.take_along_axis(cand_beam, gather_pos, axis=1)
 
-        # Rows already done freeze: keep beam 0, emit pad, scores frozen.
+        # Rows already done freeze: emit pad, scores frozen, and the beams
+        # keep THEIR OWN slots (identity, not collapse-to-beam-0): a done
+        # row's running beams never reach the output (their final-bank
+        # normalization is _EMPTY), so any permutation is output-equivalent
+        # — identity is the one that lets the delta reorder below skip the
+        # cache gather for frozen rows.
+        arange_k = jnp.arange(K, dtype=jnp.int32)[None, :]
         new_scores = jnp.where(row_done[:, None], scores, new_scores)
         new_tok = jnp.where(row_done[:, None], pad_id, new_tok)
-        beam_idx = jnp.where(row_done[:, None], 0, beam_idx)
+        beam_idx = jnp.where(row_done[:, None], arange_k, beam_idx)
 
         toks = jnp.take_along_axis(toks, beam_idx[:, :, None], axis=1)
         toks = jax.lax.dynamic_update_slice(
@@ -270,7 +294,20 @@ def beam_scan(
             ix = beam_idx.reshape(B, K, *([1] * (c.ndim - 1)))
             return jnp.take_along_axis(x, ix, axis=1).reshape(c.shape)
 
-        caches = jax.tree_util.tree_map(reorder, caches)
+        def reorder_all(cs):
+            return jax.tree_util.tree_map(reorder, cs)
+
+        if cache_reorder == "gather":
+            caches = reorder_all(caches)
+        else:
+            # Delta reorder: gather only when some beam actually switches
+            # parent. The identity branch is a pass-through lax.cond arm —
+            # no [B·K, H, T, D] gather, no HBM round trip — and shapes stay
+            # scan-stable because both arms return the same pytree.
+            caches = jax.lax.cond(
+                jnp.all(beam_idx == arange_k),
+                lambda cs: cs, reorder_all, caches,
+            )
         return (
             new_tok.reshape(B * K), new_scores, toks,
             fin_scores, fin_toks, row_done, caches,

@@ -41,7 +41,8 @@ class EncoderConfig:
     dtype: str = "bfloat16"
     # "int8" runs the hot matmuls W8A8 on the MXU (models.quant) — the
     # TPU-native successor of the reference's INT8 TFLite execution
-    # (reference ops/_tpu_runtime.py:23-31).
+    # (reference ops/_tpu_runtime.py:23-31); "w8a16" keeps the int8 weight
+    # tables but leaves activations at dtype (the memory-bound recipe).
     quant: str = "none"
     # Serving-strategy fields (payload model_config may set them, SURVEY
     # §2.8 "strategies usable by the workload"):

@@ -53,6 +53,8 @@ def dense(p: Params, x: jax.Array, dtype: Any) -> jax.Array:
 
     if quant.is_quantized(p):  # int8 leaf (models.quant leaf convention)
         return quant.qdense(p, x, dtype)
+    if quant.is_weight_only(p):  # W8A16 leaf: int8 table, dtype activations
+        return quant.wdense(p, x, dtype)
     return jnp.dot(x.astype(dtype), p["w"].astype(dtype)) + p["b"].astype(dtype)
 
 
@@ -120,22 +122,26 @@ def dot_product_attention(
 
 
 def _proj_in(leaf: Any, x: jax.Array, dtype: Any) -> jax.Array:
-    """x [B, L, d] @ leaf [d, H, E] → [B, H, L, E]; int8 path for quantized
-    leaves (``models.quant`` leaf convention)."""
+    """x [B, L, d] @ leaf [d, H, E] → [B, H, L, E]; int8 (W8A8) and W8A16
+    paths for quantized leaves (``models.quant`` leaf conventions)."""
     from agent_tpu.models import quant
 
     if quant.is_quantized(leaf):
         return quant.qproj_in(leaf, x, dtype)
+    if quant.is_weight_only(leaf):
+        return quant.wproj_in(leaf, x, dtype)
     return jnp.einsum("bld,dhe->bhle", x.astype(dtype), leaf.astype(dtype))
 
 
 def _proj_out(leaf: Any, x: jax.Array, dtype: Any) -> jax.Array:
-    """x [B, H, L, E] @ leaf [H, E, d] → [B, L, d]; int8 path for quantized
-    leaves."""
+    """x [B, H, L, E] @ leaf [H, E, d] → [B, L, d]; int8 (W8A8) and W8A16
+    paths for quantized leaves."""
     from agent_tpu.models import quant
 
     if quant.is_quantized(leaf):
         return quant.qproj_out(leaf, x, dtype)
+    if quant.is_weight_only(leaf):
+        return quant.wproj_out(leaf, x, dtype)
     return jnp.einsum("bhle,hed->bld", x, leaf.astype(dtype))
 
 

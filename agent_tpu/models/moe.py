@@ -173,6 +173,12 @@ def moe_ffn(params: Params, x: jax.Array, cfg: MoeConfig,
         # high-precision.
         h = jax.nn.gelu(quant.qmoe_expert(params["wi"], expert_in, dtype))
         expert_out = constrain(quant.qmoe_expert(params["wo"], h, dtype))
+    elif quant.is_weight_only(params["wi"]):
+        # W8A16 expert FFN (quant.wmoe_expert): int8-resident expert tables,
+        # activations stay in the compute dtype — the decode-mode recipe,
+        # same per-expert scales and routing as the W8A8 path.
+        h = jax.nn.gelu(quant.wmoe_expert(params["wi"], expert_in, dtype))
+        expert_out = constrain(quant.wmoe_expert(params["wo"], h, dtype))
     else:
         h = jax.nn.gelu(jnp.einsum(
             "gecd,edf->gecf", expert_in, params["wi"].astype(dtype)

@@ -33,6 +33,14 @@ Leaf convention: a quantized projection replaces the f32 array (or
 (+ ``"b"``). ``layers.dense`` / ``layers.attention`` / the model-local dense
 helpers dispatch on that structure, so every family (encoder, BERT, BART,
 T5) serves quantized through its unmodified forward.
+
+A second execution mode, **W8A16 weight-only** (``quant: "w8a16"``), keeps
+the same int8 weight tables but leaves activations in the compute dtype —
+no dynamic quantization pass at all. Its leaf convention is ``{"w8": int8,
+"w_scale"}`` (+ ``"b"``), and the same dispatch sites route it through
+:func:`wdense` / :func:`wproj_in` / :func:`wproj_out` / :func:`wmoe_expert`.
+W8A8 is the big-matmul *encoder* mode (MXU rate); W8A16 is the thin-matmul
+*decode* mode (HBM weight bandwidth) — see the section comments below.
 """
 
 from __future__ import annotations
@@ -90,6 +98,21 @@ def quantize_dense(p: Params) -> Params:
     return out
 
 
+def quantize_weight_w8a16(w: Any, reduce_axes: Tuple[int, ...]) -> Params:
+    """W8A16 twin of :func:`quantize_weight`: the SAME int8 table and scale,
+    stored under the weight-only leaf key ``w8`` so the dispatch sites pick
+    the activation-passthrough matmuls instead of the W8A8 ones."""
+    q = quantize_weight(w, reduce_axes)
+    return {"w8": q["w_q"], "w_scale": q["w_scale"]}
+
+
+def quantize_dense_w8a16(p: Params) -> Params:
+    """``{"w": [in, out], "b"}`` → ``{"w8", "w_scale": [out], "b"}``."""
+    out = quantize_weight_w8a16(p["w"], (0,))
+    out["b"] = np.asarray(p["b"], dtype=np.float32)
+    return out
+
+
 # ---- activation quantization (device, trace-time) ----
 
 
@@ -121,8 +144,9 @@ def quantize_act(x: jax.Array, axes: Tuple[int, ...] = (-1,)):
 # blocked re-reads of x per N-tile cost far more than the epilogue saves.
 #
 # Why the end-to-end win is ~1.2×, not the spec sheet's 2× — the measured
-# decomposition (``scripts/int8_dot_rate.py``, ``scripts/int8_ablation.py``,
-# v5e, calibrated chained-loop windows):
+# decomposition (v5e, calibrated chained-loop windows; the end-to-end
+# speedup and agreement are the recorded ``bert_base_int8`` bench leg,
+# BENCH_r05: 1.272× at top-1 agreement 1.0):
 #   - the int8 dot itself DOES run at ~2.0× the bf16 MXU rate
 #     (353-365 TOP/s vs 175-183 TF/s at MXU-saturating shapes);
 #   - the dequant epilogue is FREE — XLA fuses int32→f32·sx·sw+b into the
@@ -264,44 +288,69 @@ def qmoe_expert(p: Params, x: jax.Array, dtype: Any) -> jax.Array:
 # shardings.* spec tree; they live side by side so the structures cannot
 # drift. Scale specs keep the non-contracted entries of the weight spec
 # (e.g. wq [d, H, E] P(None, "tp", None) → scale [H, E] P("tp", None)).
+#
+# Every transformer is parameterized by ``mode`` ("int8" W8A8 / "w8a16"
+# weight-only): the two modes quantize the SAME tree paths with the SAME
+# reduce axes and differ only in the leaf convention (``w_q`` vs ``w8``),
+# so one traversal serves both and the modes cannot drift structurally.
 
 
-def _qw_spec(spec: P, reduce_axes: Sequence[int]) -> Params:
+def _qw_spec(spec: P, reduce_axes: Sequence[int], wkey: str = "w_q") -> Params:
     keep = [s for i, s in enumerate(spec) if i not in reduce_axes]
-    return {"w_q": spec, "w_scale": P(*keep)}
+    return {wkey: spec, "w_scale": P(*keep)}
 
 
-def _qdense_spec(spec: Params) -> Params:
-    out = _qw_spec(spec["w"], (0,))
+def _qdense_spec(spec: Params, wkey: str = "w_q") -> Params:
+    out = _qw_spec(spec["w"], (0,), wkey)
     out["b"] = spec["b"]
     return out
 
 
-def _quantize_attn(a: Params) -> Params:
+# mode → (weight quantizer, dense quantizer, weight-spec fn, dense-spec fn).
+_MODES = {
+    "int8": (
+        quantize_weight,
+        quantize_dense,
+        lambda s, ax: _qw_spec(s, ax, "w_q"),
+        lambda s: _qdense_spec(s, "w_q"),
+    ),
+    "w8a16": (
+        quantize_weight_w8a16,
+        quantize_dense_w8a16,
+        lambda s, ax: _qw_spec(s, ax, "w8"),
+        lambda s: _qdense_spec(s, "w8"),
+    ),
+}
+
+
+def _quantize_attn(a: Params, mode: str = "int8") -> Params:
+    qw = _MODES[mode][0]
     return {
-        "wq": quantize_weight(a["wq"], (0,)),
-        "wk": quantize_weight(a["wk"], (0,)),
-        "wv": quantize_weight(a["wv"], (0,)),
-        "wo": quantize_weight(a["wo"], (0, 1)),
+        "wq": qw(a["wq"], (0,)),
+        "wk": qw(a["wk"], (0,)),
+        "wv": qw(a["wv"], (0,)),
+        "wo": qw(a["wo"], (0, 1)),
     }
 
 
-def _quantize_attn_specs(a: Params) -> Params:
+def _quantize_attn_specs(a: Params, mode: str = "int8") -> Params:
+    ws = _MODES[mode][2]
     return {
-        "wq": _qw_spec(a["wq"], (0,)),
-        "wk": _qw_spec(a["wk"], (0,)),
-        "wv": _qw_spec(a["wv"], (0,)),
-        "wo": _qw_spec(a["wo"], (0, 1)),
+        "wq": ws(a["wq"], (0,)),
+        "wk": ws(a["wk"], (0,)),
+        "wv": ws(a["wv"], (0,)),
+        "wo": ws(a["wo"], (0, 1)),
     }
 
 
-def _quantize_block(b: Params) -> Params:
+def _quantize_block(b: Params, mode: str = "int8") -> Params:
+    qw, qd = _MODES[mode][0], _MODES[mode][1]
     nb = dict(b)
-    nb["attn"] = _quantize_attn(b["attn"])
+    nb["attn"] = _quantize_attn(b["attn"], mode)
     if "ffn" in b:
         nb["ffn"] = {
-            "wi": quantize_dense(b["ffn"]["wi"]),
-            "wo": quantize_dense(b["ffn"]["wo"]),
+            "wi": qd(b["ffn"]["wi"]),
+            "wo": qd(b["ffn"]["wo"]),
         }
     if "moe" in b:
         # Switch MoE FFN: expert-stacked weights take per-expert-per-channel
@@ -311,196 +360,210 @@ def _quantize_block(b: Params) -> Params:
         m = b["moe"]
         nb["moe"] = {
             "router": m["router"],
-            "wi": quantize_weight(m["wi"], (1,)),
-            "wo": quantize_weight(m["wo"], (1,)),
+            "wi": qw(m["wi"], (1,)),
+            "wo": qw(m["wo"], (1,)),
         }
     if "xattn" in b:
-        nb["xattn"] = _quantize_attn(b["xattn"])
+        nb["xattn"] = _quantize_attn(b["xattn"], mode)
     return nb
 
 
-def _quantize_block_specs(b: Params) -> Params:
+def _quantize_block_specs(b: Params, mode: str = "int8") -> Params:
+    ws = _MODES[mode][2]
+    ds = _MODES[mode][3]
     nb = dict(b)
-    nb["attn"] = _quantize_attn_specs(b["attn"])
+    nb["attn"] = _quantize_attn_specs(b["attn"], mode)
     if "ffn" in b:
         nb["ffn"] = {
-            "wi": _qdense_spec(b["ffn"]["wi"]),
-            "wo": _qdense_spec(b["ffn"]["wo"]),
+            "wi": ds(b["ffn"]["wi"]),
+            "wo": ds(b["ffn"]["wo"]),
         }
     if "moe" in b:
         m = b["moe"]
         nb["moe"] = {
             "router": m["router"],
-            "wi": _qw_spec(m["wi"], (1,)),   # scale [E, d_out] → P("ep", ·)
-            "wo": _qw_spec(m["wo"], (1,)),
+            "wi": ws(m["wi"], (1,)),   # scale [E, d_out] → P("ep", ·)
+            "wo": ws(m["wo"], (1,)),
         }
     if "xattn" in b:
-        nb["xattn"] = _quantize_attn_specs(b["xattn"])
+        nb["xattn"] = _quantize_attn_specs(b["xattn"], mode)
     return nb
 
 
-def quantize_encoder(params: Params) -> Params:
+def quantize_encoder(params: Params, mode: str = "int8") -> Params:
     """In-house encoder tree (``models.encoder.init_params``): quantize every
     block's QKVO + FFN; embeddings, LNs, and the head stay f32."""
     out = dict(params)
-    out["blocks"] = [_quantize_block(b) for b in params["blocks"]]
+    out["blocks"] = [_quantize_block(b, mode) for b in params["blocks"]]
     return out
 
 
-def quantize_encoder_specs(specs: Params) -> Params:
+def quantize_encoder_specs(specs: Params, mode: str = "int8") -> Params:
     out = dict(specs)
-    out["blocks"] = [_quantize_block_specs(b) for b in specs["blocks"]]
+    out["blocks"] = [_quantize_block_specs(b, mode) for b in specs["blocks"]]
     return out
 
 
-def quantize_bert(params: Params) -> Params:
+def quantize_bert(params: Params, mode: str = "int8") -> Params:
     """HF-BERT tree (``models.bert.from_state_dict``): per-layer QKVO + FFN
     dense dicts; embeddings, LNs, pooler, and head stay f32."""
+    qd = _MODES[mode][1]
     out = dict(params)
     out["layers"] = []
     for blk in params["layers"]:
         a, f = blk["attn"], blk["ffn"]
         out["layers"].append({
             "attn": {
-                "q": quantize_dense(a["q"]),
-                "k": quantize_dense(a["k"]),
-                "v": quantize_dense(a["v"]),
-                "o": quantize_dense(a["o"]),
+                "q": qd(a["q"]),
+                "k": qd(a["k"]),
+                "v": qd(a["v"]),
+                "o": qd(a["o"]),
                 "ln": a["ln"],
             },
             "ffn": {
-                "i": quantize_dense(f["i"]),
-                "o": quantize_dense(f["o"]),
+                "i": qd(f["i"]),
+                "o": qd(f["o"]),
                 "ln": f["ln"],
             },
         })
     return out
 
 
-def quantize_bert_specs(specs: Params) -> Params:
+def quantize_bert_specs(specs: Params, mode: str = "int8") -> Params:
+    ds = _MODES[mode][3]
     out = dict(specs)
     out["layers"] = []
     for blk in specs["layers"]:
         a, f = blk["attn"], blk["ffn"]
         out["layers"].append({
             "attn": {
-                "q": _qdense_spec(a["q"]),
-                "k": _qdense_spec(a["k"]),
-                "v": _qdense_spec(a["v"]),
-                "o": _qdense_spec(a["o"]),
+                "q": ds(a["q"]),
+                "k": ds(a["k"]),
+                "v": ds(a["v"]),
+                "o": ds(a["o"]),
                 "ln": a["ln"],
             },
             "ffn": {
-                "i": _qdense_spec(f["i"]),
-                "o": _qdense_spec(f["o"]),
+                "i": ds(f["i"]),
+                "o": ds(f["o"]),
                 "ln": f["ln"],
             },
         })
     return out
 
 
-def quantize_seq2seq(params: Params) -> Params:
+def quantize_seq2seq(params: Params, mode: str = "int8") -> Params:
     """In-house seq2seq tree (``models.seq2seq.init_params``): quantize every
     encoder/decoder block (incl. cross-attention); embeddings and final LNs
     stay f32 (the lm head is the tied embedding — unquantized)."""
     out = dict(params)
-    out["enc"] = [_quantize_block(b) for b in params["enc"]]
-    out["dec"] = [_quantize_block(b) for b in params["dec"]]
+    out["enc"] = [_quantize_block(b, mode) for b in params["enc"]]
+    out["dec"] = [_quantize_block(b, mode) for b in params["dec"]]
     return out
 
 
-def quantize_seq2seq_specs(specs: Params) -> Params:
+def quantize_seq2seq_specs(specs: Params, mode: str = "int8") -> Params:
     out = dict(specs)
-    out["enc"] = [_quantize_block_specs(b) for b in specs["enc"]]
-    out["dec"] = [_quantize_block_specs(b) for b in specs["dec"]]
+    out["enc"] = [_quantize_block_specs(b, mode) for b in specs["enc"]]
+    out["dec"] = [_quantize_block_specs(b, mode) for b in specs["dec"]]
     return out
 
 
-def _quantize_bart_block(blk: Params) -> Params:
+def _quantize_bart_block(blk: Params, mode: str = "int8") -> Params:
+    qd = _MODES[mode][1]
     nb = dict(blk)
-    nb["self"] = {k: quantize_dense(v) for k, v in blk["self"].items()}
+    nb["self"] = {k: qd(v) for k, v in blk["self"].items()}
     if "cross" in blk:
-        nb["cross"] = {k: quantize_dense(v) for k, v in blk["cross"].items()}
-    nb["fc1"] = quantize_dense(blk["fc1"])
-    nb["fc2"] = quantize_dense(blk["fc2"])
+        nb["cross"] = {k: qd(v) for k, v in blk["cross"].items()}
+    nb["fc1"] = qd(blk["fc1"])
+    nb["fc2"] = qd(blk["fc2"])
     return nb
 
 
-def _quantize_bart_block_specs(blk: Params) -> Params:
+def _quantize_bart_block_specs(blk: Params, mode: str = "int8") -> Params:
+    ds = _MODES[mode][3]
     nb = dict(blk)
-    nb["self"] = {k: _qdense_spec(v) for k, v in blk["self"].items()}
+    nb["self"] = {k: ds(v) for k, v in blk["self"].items()}
     if "cross" in blk:
-        nb["cross"] = {k: _qdense_spec(v) for k, v in blk["cross"].items()}
-    nb["fc1"] = _qdense_spec(blk["fc1"])
-    nb["fc2"] = _qdense_spec(blk["fc2"])
+        nb["cross"] = {k: ds(v) for k, v in blk["cross"].items()}
+    nb["fc1"] = ds(blk["fc1"])
+    nb["fc2"] = ds(blk["fc2"])
     return nb
 
 
-def quantize_bart(params: Params) -> Params:
+def quantize_bart(params: Params, mode: str = "int8") -> Params:
     """HF-BART tree (``models.bart.from_state_dict``): QKVO + FFN dense dicts
     per layer; embeddings / position tables / LNs / final_logits_bias stay
     f32 (the lm head is the tied embedding)."""
     out = dict(params)
     for branch in ("enc", "dec"):
         br = dict(params[branch])
-        br["layers"] = [_quantize_bart_block(b) for b in params[branch]["layers"]]
-        out[branch] = br
-    return out
-
-
-def quantize_bart_specs(specs: Params) -> Params:
-    out = dict(specs)
-    for branch in ("enc", "dec"):
-        br = dict(specs[branch])
         br["layers"] = [
-            _quantize_bart_block_specs(b) for b in specs[branch]["layers"]
+            _quantize_bart_block(b, mode) for b in params[branch]["layers"]
         ]
         out[branch] = br
     return out
 
 
-def _quantize_t5_block(blk: Params) -> Params:
+def quantize_bart_specs(specs: Params, mode: str = "int8") -> Params:
+    out = dict(specs)
+    for branch in ("enc", "dec"):
+        br = dict(specs[branch])
+        br["layers"] = [
+            _quantize_bart_block_specs(b, mode)
+            for b in specs[branch]["layers"]
+        ]
+        out[branch] = br
+    return out
+
+
+def _quantize_t5_block(blk: Params, mode: str = "int8") -> Params:
+    qw = _MODES[mode][0]
     nb = dict(blk)
     nb["attn"] = {
-        k: quantize_weight(w, (0,)) for k, w in blk["attn"].items()
+        k: qw(w, (0,)) for k, w in blk["attn"].items()
     }
     if "cross" in blk:
         nb["cross"] = {
-            k: quantize_weight(w, (0,)) for k, w in blk["cross"].items()
+            k: qw(w, (0,)) for k, w in blk["cross"].items()
         }
     nb["ffn"] = {
-        k: quantize_weight(w, (0,)) for k, w in blk["ffn"].items()
+        k: qw(w, (0,)) for k, w in blk["ffn"].items()
     }
     return nb
 
 
-def _quantize_t5_block_specs(blk: Params) -> Params:
+def _quantize_t5_block_specs(blk: Params, mode: str = "int8") -> Params:
+    ws = _MODES[mode][2]
     nb = dict(blk)
-    nb["attn"] = {k: _qw_spec(s, (0,)) for k, s in blk["attn"].items()}
+    nb["attn"] = {k: ws(s, (0,)) for k, s in blk["attn"].items()}
     if "cross" in blk:
-        nb["cross"] = {k: _qw_spec(s, (0,)) for k, s in blk["cross"].items()}
-    nb["ffn"] = {k: _qw_spec(s, (0,)) for k, s in blk["ffn"].items()}
+        nb["cross"] = {k: ws(s, (0,)) for k, s in blk["cross"].items()}
+    nb["ffn"] = {k: ws(s, (0,)) for k, s in blk["ffn"].items()}
     return nb
 
 
-def quantize_t5(params: Params) -> Params:
+def quantize_t5(params: Params, mode: str = "int8") -> Params:
     """HF-T5 tree (``models.t5.from_state_dict``): bias-free QKVO + FFN bare
     matrices per layer; embeddings, RMSNorm scales, relative-bias tables, and
     the (possibly untied) lm head stay f32."""
     out = dict(params)
     for branch in ("enc", "dec"):
         br = dict(params[branch])
-        br["layers"] = [_quantize_t5_block(b) for b in params[branch]["layers"]]
+        br["layers"] = [
+            _quantize_t5_block(b, mode) for b in params[branch]["layers"]
+        ]
         out[branch] = br
     return out
 
 
-def quantize_t5_specs(specs: Params) -> Params:
+def quantize_t5_specs(specs: Params, mode: str = "int8") -> Params:
     out = dict(specs)
     for branch in ("enc", "dec"):
         br = dict(specs[branch])
         br["layers"] = [
-            _quantize_t5_block_specs(b) for b in specs[branch]["layers"]
+            _quantize_t5_block_specs(b, mode)
+            for b in specs[branch]["layers"]
         ]
         out[branch] = br
     return out
@@ -518,15 +581,21 @@ _FAMILY_QUANTIZERS = {
 }
 
 
-def quantize_for_family(family: str, params: Params) -> Params:
-    return _FAMILY_QUANTIZERS[family]()[0](params)
+def quantize_for_family(family: str, params: Params,
+                        mode: str = "int8") -> Params:
+    return _FAMILY_QUANTIZERS[family]()[0](params, mode)
 
 
-def quantize_specs_for_family(family: str, specs: Params) -> Params:
-    return _FAMILY_QUANTIZERS[family]()[1](specs)
+def quantize_specs_for_family(family: str, specs: Params,
+                              mode: str = "int8") -> Params:
+    return _FAMILY_QUANTIZERS[family]()[1](specs, mode)
 
 
-VALID_QUANT = ("none", "int8")
+# quant values that trigger the build-time tree transform (everything but
+# "none"); _model_common.maybe_quantize_params gates on membership here so a
+# new mode needs exactly one registration (this tuple + _MODES).
+QUANTIZED_MODES = ("int8", "w8a16")
+VALID_QUANT = ("none",) + QUANTIZED_MODES
 
 
 def validate_quant(value: str) -> str:

@@ -40,7 +40,9 @@ class Seq2SeqConfig:
     max_tgt_len: int = 130        # reference generate max_length default (:46)
     dtype: str = "bfloat16"
     # "int8": W8A8 quantized matmuls (models.quant) in encode AND decode —
-    # the reference's INT8 device execution, TPU-native.
+    # the reference's INT8 device execution, TPU-native. "w8a16": weight-only
+    # int8 (activations stay at dtype) — the decode-mode recipe for
+    # HBM-bound thin matmuls.
     quant: str = "none"
 
     @property

@@ -56,7 +56,9 @@ class T5Config:
     decoder_start_id: int = 0   # T5 starts decode from pad
     layer_norm_eps: float = 1e-6
     dtype: str = "bfloat16"
-    # "int8": serve with W8A8 quantized matmuls (models.quant).
+    # "int8": serve with W8A8 quantized matmuls (models.quant); "w8a16":
+    # weight-only int8 — the decode-mode recipe (int8-resident weights
+    # dequantized in-register, activations stay at dtype).
     quant: str = "none"
 
     # Uniform serving-config view (map_summarize reads these off any family).
@@ -128,6 +130,8 @@ def _dense(w: jax.Array, x: jax.Array, dtype) -> jax.Array:
 
     if quant.is_quantized(w):  # int8 leaf (models.quant convention)
         return quant.qdense(w, x, dtype)
+    if quant.is_weight_only(w):  # W8A16 leaf: decode-mode weight-only int8
+        return quant.wdense(w, x, dtype)
     return jnp.dot(x.astype(dtype), w.astype(dtype))
 
 

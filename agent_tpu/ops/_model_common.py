@@ -67,25 +67,33 @@ def apply_quant_env(payload: Dict[str, Any], cfg):
 
 
 def maybe_quantize_params(params, family: str, cfg):
-    """The shared int8 build-time transform gate (guard + dispatch), so the
-    two model ops cannot drift. Host-side quantization BEFORE HBM placement:
-    the int8 tables — 4× smaller than f32 — are what transfer and stay
-    resident (``models.quant``)."""
-    if getattr(cfg, "quant", "none") == "int8":
+    """The shared quantized-mode build-time transform gate (guard +
+    dispatch), so the two model ops cannot drift. Covers both execution
+    modes — ``int8`` (W8A8, the encoder mode) and ``w8a16`` (weight-only,
+    the decode mode). Host-side quantization BEFORE HBM placement: the int8
+    tables — 4× smaller than f32 — are what transfer and stay resident
+    (``models.quant``)."""
+    mode = getattr(cfg, "quant", "none")
+    from agent_tpu.models.quant import QUANTIZED_MODES
+
+    if mode in QUANTIZED_MODES:
         from agent_tpu.models.quant import quantize_for_family
 
-        return quantize_for_family(family, params)
+        return quantize_for_family(family, params, mode)
     return params
 
 
 def maybe_quantize_specs(specs, family: str, cfg):
     """Spec-tree twin of :func:`maybe_quantize_params`: the quantized tree
-    has ``{"w_q", "w_scale"}`` leaves, so tp placement specs transform the
-    same paths."""
-    if getattr(cfg, "quant", "none") == "int8":
+    has ``{"w_q", "w_scale"}`` (int8) or ``{"w8", "w_scale"}`` (w8a16)
+    leaves, so tp placement specs transform the same paths."""
+    mode = getattr(cfg, "quant", "none")
+    from agent_tpu.models.quant import QUANTIZED_MODES
+
+    if mode in QUANTIZED_MODES:
         from agent_tpu.models.quant import quantize_specs_for_family
 
-        return quantize_specs_for_family(family, specs)
+        return quantize_specs_for_family(family, specs, mode)
     return specs
 
 

@@ -84,7 +84,10 @@ def _resolve_family(model_id: str) -> str:
 
 
 # model_config fields a payload may override for a checkpoint model:
-# serving controls only (structural fields are the checkpoint's).
+# serving controls only (structural fields are the checkpoint's). "quant"
+# accepts "int8" (W8A8) and "w8a16" (weight-only — the decode-targeted mode:
+# summarize is weight-HBM-bound per step, so a T5/BART checkpoint serves
+# with int8-resident weights dequantized in-register at dtype).
 _CKPT_SERVING_OVERRIDES = ("dtype", "quant")
 
 

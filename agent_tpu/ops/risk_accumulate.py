@@ -42,6 +42,7 @@ def _merge_partials(payload: Dict[str, Any], t0: float) -> Dict[str, Any]:
     total = 0.0
     mn: Optional[float] = None
     mx: Optional[float] = None
+    nan_in = False
     for i, p in enumerate(partials):
         if isinstance(p, dict) and p.get("ok") is False:
             # A soft-failed shard slipped through as a SUCCEEDED dep — its
@@ -63,11 +64,18 @@ def _merge_partials(payload: Dict[str, Any], t0: float) -> Dict[str, Any]:
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 raise ValueError(f"each non-empty partial needs numeric {key!r}")
         count += c
-        total += float(p["sum"])
-        v = float(p["min"])
-        mn = v if mn is None else min(mn, v)
-        v = float(p["max"])
-        mx = v if mx is None else max(mx, v)
+        s, lo, hi = float(p["sum"]), float(p["min"]), float(p["max"])
+        # A NaN-poisoned shard partial (the map stage emits min=max=sum=NaN
+        # for NaN-carrying shards) must poison the MERGE order-independently
+        # too: Python min/max keep or drop NaN depending on argument order
+        # (min(nan, x) = nan, min(x, nan) = x), so a flag — not the bare
+        # min/max chain — carries the poison.
+        nan_in = nan_in or math.isnan(s) or math.isnan(lo) or math.isnan(hi)
+        total += s
+        mn = lo if mn is None else min(mn, lo)
+        mx = hi if mx is None else max(mx, hi)
+    if nan_in:
+        total = mn = mx = float("nan")
     if count == 0:
         out = _zero_result(t0)
         out["n_partials"] = len(partials)  # same schema as non-empty merges

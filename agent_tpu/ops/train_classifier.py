@@ -179,8 +179,10 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     # wired into the train step — reject rather than silently train dense.
     if cfg.pp > 1:
         return bad_input("train_classifier does not support pp configs")
-    if cfg.moe_experts > 0 and cfg.quant == "int8":
-        return bad_input("MoE training does not support quant=int8")
+    if cfg.moe_experts > 0 and cfg.quant != "none":
+        return bad_input(
+            f"MoE training does not support quant={cfg.quant}"
+        )
 
     if ctx is not None and getattr(ctx, "require_runtime", None):
         runtime = ctx.require_runtime()

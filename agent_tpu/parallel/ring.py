@@ -41,6 +41,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from agent_tpu.models.layers import NEG_INF, dot_product_attention
+from agent_tpu.utils.compat import pcast_varying, shard_map
 
 
 def _ring_local(q, k, v, mask, sp: int, use_flash_fold: bool = False):
@@ -69,8 +70,9 @@ def _ring_local(q, k, v, mask, sp: int, use_flash_fold: bool = False):
 
     b, h, lq, _ = q.shape
     # Mark the zero-init carry device-varying: shard_map requires the scan
-    # carry's manual-axes type to match its (varying) outputs.
-    varying = partial(lax.pcast, axis_name=("dp", "tp", "sp"), to="varying")
+    # carry's manual-axes type to match its (varying) outputs. (No-op on
+    # pre-vma jax — see compat.pcast_varying.)
+    varying = partial(pcast_varying, axis_name=("dp", "tp", "sp"))
     m0 = varying(jnp.full((b, h, lq, 1), NEG_INF, dtype=jnp.float32))
     l0 = varying(jnp.zeros((b, h, lq, 1), dtype=jnp.float32))
     acc0 = varying(jnp.zeros(q.shape, dtype=jnp.float32))
@@ -143,7 +145,7 @@ def make_ring_attention(mesh: Mesh, use_flash_fold: bool = None):
     if use_flash_fold is None:
         use_flash_fold = jax.default_backend() == "tpu"
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         partial(_ring_local, sp=sp, use_flash_fold=use_flash_fold),
         mesh=mesh,
         in_specs=(

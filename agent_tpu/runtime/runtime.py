@@ -298,6 +298,11 @@ class TpuRuntime:
             "n_devices": self.n_devices,
             "mesh": dict(self.mesh.shape),
             "compute_dtype": self.compute_dtype,
+            # Fleet-default quantized execution mode (TPU_QUANT via
+            # DeviceConfig.quant): operators can see from lease telemetry
+            # whether a worker serves int8/w8a16 by default. Per-task
+            # resolution stays in ops/_model_common.apply_quant_env.
+            "quant_default": self.config.quant or "none",
             "executable_cache": self.cache.stats(),
             "models_resident": sorted(self._model_ids_snapshot()),
         }

@@ -72,6 +72,11 @@ LONG_CTX_ITERS = 5
 LONG_CTX_CONFIG = {"d_model": 512, "n_heads": 4, "max_len": 4096}
 SUMMARIZE_BATCH = 256
 SUMMARIZE_MAX_NEW = 32
+# Quantization-fidelity sample size (rows) for the agreement numbers that
+# ride next to the int8/w8a16 throughput legs. 512 rows put the one-sided
+# 95% CI for "agreement ≥ 0.99" at ~±0.9 points — too loose for a headline;
+# ≥5k rows tightens it below ±0.3 (round-4 ask #4).
+AGREEMENT_ROWS = 5120
 # Batch 128 + remat-free is the measured optimum now that the trainable
 # flash kernel gates at 512 (FLASH_TRAIN_MIN_KEY_LEN): no stored score
 # tensors OR block activations. Swept on v5e: 128/none 308 ex/s (45.3%
@@ -284,7 +289,7 @@ def _bench_bert_base_int8(runtime, bf16_leg):
              "wire", "flag", "normal", "urgent", "invoice", "metric"]
     texts = [
         " ".join(rng.choice(words, size=60).tolist()) + f" case {i}"
-        for i in range(512 if not smoke else 64)
+        for i in range(AGREEMENT_ROWS if not smoke else 64)
     ]
     payload = {"texts": texts, "topk": 1, "allow_fallback": False,
                "result_format": "columnar",
@@ -608,12 +613,15 @@ SUMMARIZE_ITERS = 4
 
 def _bench_summarize(runtime, batch: int = SUMMARIZE_BATCH,
                      max_new: int = SUMMARIZE_MAX_NEW,
-                     iters: int = SUMMARIZE_ITERS, num_beams: int = 1):
+                     iters: int = SUMMARIZE_ITERS, num_beams: int = 1,
+                     quant: str = None):
     """Decode throughput through the op. ``num_beams=4`` is the reference's
     unconditional decode mode (``/root/reference/ops/map_summarize.py:57``;
     greedy is this framework's documented default-divergence) — the beam leg
     records what that output-quality parity costs. tok/s counts EMITTED
-    tokens; beam explores num_beams× more decoder compute per emitted token."""
+    tokens; beam explores num_beams× more decoder compute per emitted token.
+    ``quant`` serves the mode via ``model_config`` ("w8a16" is the
+    decode-targeted weight-only mode, models/quant.py)."""
     from agent_tpu.ops import get_op
     from agent_tpu.runtime.context import OpContext
 
@@ -623,6 +631,7 @@ def _bench_summarize(runtime, batch: int = SUMMARIZE_BATCH,
         "texts": ["a document to compress " * 20] * batch,
         "max_length": max_new,
         **({"num_beams": num_beams} if num_beams > 1 else {}),
+        **({"model_config": {"quant": quant}} if quant else {}),
     }
     summarize(payload, ctx)  # warmup/compile
 
@@ -637,9 +646,113 @@ def _bench_summarize(runtime, batch: int = SUMMARIZE_BATCH,
         return batch * max_new * iters / dt, dt * 1000.0
 
     tok_per_sec, _, spread = _median_windows(window, WINDOWS)
-    return {"decode_tok_per_sec": round(tok_per_sec, 1),
-            "spread_pct": round(spread, 2), "windows": WINDOWS,
-            "iters": iters, "num_beams": num_beams}
+    leg = {"decode_tok_per_sec": round(tok_per_sec, 1),
+           "spread_pct": round(spread, 2), "windows": WINDOWS,
+           "iters": iters, "num_beams": num_beams}
+    if quant:
+        leg["quant"] = quant
+    return leg
+
+
+def _w8a16_decode_agreement(runtime, num_beams: int = 4, max_new: int = 16):
+    """Token/sequence agreement of W8A16 decode vs the bf16 reference over
+    ≥``AGREEMENT_ROWS`` rows (smoke: 64) at the serving seq2seq config —
+    the quantization-fidelity number next to the w8a16 throughput legs.
+
+    Model-level on purpose: comparing emitted TOKEN arrays (not detokenized
+    strings) makes the metric exact, and the op above already proves the
+    serving contract. Chunked decode bounds the [B·K, H, T, D] cache HBM.
+
+    ``agreement_control_token`` is the NO-QUANT control: the same bf16
+    reference against the f32 decode of the SAME weights. Free-running
+    decode on the bench's untrained deterministic-random model amplifies
+    any perturbation (near-uniform next-token distributions + cascades), so
+    the control prices that substrate noise — measured on CPU dev runs the
+    bf16-vs-f32 control (0.976) disagrees MORE than w8a16-vs-bf16 (0.988):
+    weight-only int8 adds no token flips beyond existing compute-dtype
+    noise, which is the claim that matters. Judge agreement_token against
+    the control, not against 1.0."""
+    import jax
+    import numpy as np
+    from dataclasses import replace
+
+    from agent_tpu.models import quant, seq2seq
+
+    smoke = runtime.platform != "tpu"
+    rows = 64 if smoke else AGREEMENT_ROWS
+    chunk = 64 if smoke else 1024
+    # Smoke shrinks the model like the other legs do (CPU beam-4 decode at
+    # the serving config takes minutes/row-batch); TPU measures the real one.
+    cfg = seq2seq.Seq2SeqConfig() if not smoke else seq2seq.Seq2SeqConfig(
+        d_model=64, n_heads=4, n_enc_layers=1, n_dec_layers=1, d_ff=128,
+        max_src_len=64, max_tgt_len=16, dtype="float32",
+    )
+    ctl_cfg = replace(
+        cfg, dtype="float32" if cfg.dtype != "float32" else "bfloat16"
+    )
+    params = seq2seq.init_params(cfg, model_id="bench-w8a16-agree")
+    qparams = quant.quantize_for_family("seq2seq", params, "w8a16")
+    params = jax.device_put(params, runtime.replicated())
+    qparams = jax.device_put(qparams, runtime.replicated())
+
+    def make_gen(c):
+        return jax.jit(
+            lambda p, i, m: seq2seq.beam_generate(
+                p, i, m, c, max_new, num_beams=num_beams,
+            )
+        )
+
+    gen, gen_ctl = make_gen(cfg), make_gen(ctl_cfg)
+    rng = np.random.default_rng(11)
+    src_len = 32 if smoke else 64
+    tok_match = ctl_match = tok_total = seq_match = 0
+    for s in range(0, rows, chunk):
+        n = min(chunk, rows - s)
+        ids = rng.integers(4, cfg.vocab_size, size=(n, src_len)).astype(
+            np.int32
+        )
+        mask = np.ones((n, src_len), dtype=np.int32)
+        ref = np.asarray(gen(params, ids, mask)[0])
+        got = np.asarray(gen(qparams, ids, mask)[0])
+        ctl = np.asarray(gen_ctl(params, ids, mask)[0])
+        tok_match += int((ref == got).sum())
+        ctl_match += int((ref == ctl).sum())
+        tok_total += ref.size
+        seq_match += int((ref == got).all(axis=1).sum())
+    return {
+        "agreement_token": round(tok_match / tok_total, 4),
+        "agreement_seq": round(seq_match / rows, 4),
+        "agreement_control_token": round(ctl_match / tok_total, 4),
+        "agreement_rows": rows,
+        "agreement_num_beams": num_beams,
+    }
+
+
+def _bench_summarize_w8a16(runtime, greedy_ref, beam_ref):
+    """W8A16 weight-only decode (models/quant.py wdense/wproj_*): the
+    memory-bound recipe for [rows, d]-thin decode matmuls — int8-resident
+    weights (half the bf16 HBM bytes) dequantized in-register, activations
+    untouched, NO dynamic quantization pass. Records greedy and beam-4
+    throughput, the ``w8a16_vs_bf16`` speedups vs the recorded bf16 legs,
+    and token/sequence agreement over ≥``AGREEMENT_ROWS`` rows.
+
+    Returns (greedy_leg, beam_leg); agreement fields ride on the beam leg
+    (beam-4 is the reference's decode mode and the mode the speedup bar
+    ≥1.15 targets)."""
+    smoke = runtime.platform != "tpu"
+    kw = dict(batch=8, max_new=8, iters=1) if smoke else {}
+    leg = _bench_summarize(runtime, quant="w8a16", **kw)
+    if not smoke and greedy_ref and greedy_ref.get("decode_tok_per_sec"):
+        leg["w8a16_vs_bf16"] = round(
+            leg["decode_tok_per_sec"] / greedy_ref["decode_tok_per_sec"], 3
+        )
+    beam = _bench_summarize(runtime, num_beams=4, quant="w8a16", **kw)
+    if not smoke and beam_ref and beam_ref.get("decode_tok_per_sec"):
+        beam["w8a16_vs_bf16"] = round(
+            beam["decode_tok_per_sec"] / beam_ref["decode_tok_per_sec"], 3
+        )
+    beam.update(_w8a16_decode_agreement(runtime))
+    return leg, beam
 
 
 def _bench_csv_index(tmpdir: str, n_rows: int = 1_000_000, repeats: int = 3):
@@ -735,8 +848,11 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
     # small enough that W8A8's dynamic activation quantization costs more
     # than the MXU saves — measured 3,983 rows/s int8 vs 4,980 bf16 at
     # B=1024 through this op. int8's win is the big-matmul encoders
-    # (BERT-base leg: 1.21×); the summarize lever is decode BATCH (4,980 →
-    # 8,093 rows/s from B=1024 → 8192 — see DRAIN_SUMMARIZE_SHARD).
+    # (BERT-base leg: 1.21×); the summarize levers are decode BATCH (4,980 →
+    # 8,093 rows/s from B=1024 → 8192 — see DRAIN_SUMMARIZE_SHARD) and
+    # W8A16 weight-only quant (no activation-quant pass, half the weight
+    # HBM bytes — the summarize_w8a16 legs record it; the drain default
+    # stays bf16 until a recorded w8a16 drain win justifies flipping it).
     summarize_extra = {"text_field": "text", "max_length": SUMMARIZE_MAX_NEW,
                        "allow_fallback": False}
 
@@ -861,6 +977,20 @@ def main() -> int:
             # kill the line, but the cause must surface in the artifact.
             legs[name] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
+    # W8A16 weight-only decode: two legs (greedy + beam-4) from one runner,
+    # speedups referenced against the bf16 legs recorded just above.
+    try:
+        w_greedy, w_beam = _bench_summarize_w8a16(
+            runtime, legs.get("summarize"), legs.get("summarize_beam")
+        )
+        legs["summarize_w8a16"] = w_greedy
+        legs["summarize_w8a16_beam"] = w_beam
+    except Exception as exc:  # noqa: BLE001
+        legs["summarize_w8a16"] = {
+            "error": f"{type(exc).__name__}: {exc}"[:300]
+        }
+        legs["summarize_w8a16_beam"] = legs["summarize_w8a16"]
+
     import tempfile
 
     try:
@@ -896,6 +1026,7 @@ def main() -> int:
                     "summarize_batch": SUMMARIZE_BATCH,
                     "summarize_max_new": SUMMARIZE_MAX_NEW,
                     "summarize_iters": SUMMARIZE_ITERS,
+                    "agreement_rows": AGREEMENT_ROWS,
                     "train_batch": TRAIN_BATCH,
                     "train_steps": TRAIN_STEPS,
                     "drain_rows": DRAIN_ROWS,
@@ -932,6 +1063,21 @@ def main() -> int:
                 ),
                 "summarize_beam_tok_per_sec": legs["summarize_beam"].get(
                     "decode_tok_per_sec"
+                ),
+                "summarize_w8a16_tok_per_sec": legs["summarize_w8a16"].get(
+                    "decode_tok_per_sec"
+                ),
+                "summarize_w8a16_beam_tok_per_sec": legs[
+                    "summarize_w8a16_beam"
+                ].get("decode_tok_per_sec"),
+                "w8a16_vs_bf16": legs["summarize_w8a16_beam"].get(
+                    "w8a16_vs_bf16"
+                ),
+                "w8a16_agreement_token": legs["summarize_w8a16_beam"].get(
+                    "agreement_token"
+                ),
+                "w8a16_agreement_control": legs["summarize_w8a16_beam"].get(
+                    "agreement_control_token"
                 ),
                 "flash_vs_dense_8k": legs["long_ctx"].get("flash_vs_dense_8k"),
                 "csv_index_mb_per_sec": legs["csv_index"].get("mb_per_sec"),

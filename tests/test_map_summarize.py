@@ -210,6 +210,57 @@ class TestBeamSearch:
         bs = score_of(np.asarray(b_toks))
         assert (bs >= gs - 1e-4).all(), (bs, gs)
 
+    def test_cache_reorder_delta_equals_gather(self):
+        """The delta (lax.cond identity-skip) KV-cache reorder must emit
+        BIT-IDENTICAL tokens to the unconditional per-step gather it
+        replaced — same beam_idx, the only difference is whether identity
+        permutations move cache bytes. Run across length penalties so both
+        early-banking and run-to-the-end hypotheses are covered."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from agent_tpu.models.decoding import beam_scan
+        from agent_tpu.models.tokenizer import BOS_ID, EOS_ID, PAD_ID
+
+        seq2seq, cfg, params, src, mask = self._setup()
+        B, K, T = src.shape[0], 4, 8
+        enc_out = seq2seq.encode(params, src, mask, cfg)
+        enc_out = jnp.repeat(enc_out, K, axis=0)
+        enc_mask = jnp.repeat(mask, K, axis=0)
+
+        def step_fn(tok, step, caches):
+            return seq2seq._decode_step(
+                params, tok, step, enc_out, enc_mask, caches, cfg
+            )
+
+        for lp in (0.0, 1.0, 2.0):
+            outs = {}
+            for scheme in ("gather", "delta"):
+                toks, lens = beam_scan(
+                    step_fn, seq2seq._empty_cache(cfg, B * K), B,
+                    cfg.vocab_size, T, num_beams=K,
+                    start_id=BOS_ID, eos_id=EOS_ID, pad_id=PAD_ID,
+                    length_penalty=lp, cache_reorder=scheme,
+                )
+                outs[scheme] = (np.asarray(toks), np.asarray(lens))
+            np.testing.assert_array_equal(
+                outs["delta"][0], outs["gather"][0],
+                err_msg=f"token mismatch at length_penalty={lp}",
+            )
+            np.testing.assert_array_equal(outs["delta"][1], outs["gather"][1])
+
+    def test_cache_reorder_rejects_unknown_scheme(self):
+        import pytest
+
+        from agent_tpu.models.decoding import beam_scan
+
+        with pytest.raises(ValueError, match="cache_reorder"):
+            beam_scan(
+                lambda t, s, c: (None, c), None, 1, 8, 4,
+                num_beams=2, start_id=1, eos_id=2,
+                cache_reorder="sometimes",
+            )
+
     def test_op_accepts_num_beams(self):
         from agent_tpu.ops import get_op
 

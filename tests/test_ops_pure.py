@@ -205,6 +205,29 @@ class TestRiskAccumulateMapReduce:
         bad = run({"partials": [{"count": "x"}]})
         assert bad["ok"] is False
 
+    def test_partials_merge_nan_poison_order_independent(self):
+        """A NaN-poisoned shard partial (the map stage's contract for
+        NaN-carrying shards: sum=min=max=NaN) must poison the merged stats
+        regardless of partial ORDER — Python min/max alone keep or drop NaN
+        depending on argument position, which made the merged result depend
+        on shard completion order (ADVICE r5)."""
+        import math
+
+        from agent_tpu.ops import get_op
+
+        run = get_op("risk_accumulate")
+        poisoned = run({"values": [float("nan"), 1.0]})
+        assert math.isnan(poisoned["min"]) and math.isnan(poisoned["max"])
+        clean = run({"values": [2.0, 7.0]})
+        for order in ([poisoned, clean], [clean, poisoned]):
+            merged = run({"partials": list(order)})
+            assert merged["ok"] is True and merged["count"] == 4
+            for key in ("sum", "mean", "min", "max"):
+                assert math.isnan(merged[key]), (key, order, merged)
+        # NaN-free merges stay exact.
+        merged = run({"partials": [clean, clean]})
+        assert merged["min"] == 2.0 and merged["max"] == 7.0
+
 
 def test_map_tokenize_bpe_mode(tmp_path):
     """tokenizer: 'bpe' with a local vocab dir — ids match the BPE module

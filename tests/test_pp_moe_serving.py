@@ -132,6 +132,48 @@ def test_moe_int8_ep_sharding_matches_unsharded():
     np.testing.assert_allclose(got_scores, want_scores, atol=1e-5)
 
 
+def test_pp_w8a16_matches_unpipelined_w8a16():
+    """{"pp": 2, "quant": "w8a16"}: the pipelined weight-only forward runs
+    the SAME wdense/wproj ops in the same order as the non-pp w8a16 serve,
+    so results match — W8A16 composes with PP the way int8 does."""
+    rt = get_runtime()
+    want_idx, want_scores = _classify(rt, {**BASE_CONFIG, "quant": "w8a16"})
+    got_idx, got_scores = _classify(
+        rt, {**BASE_CONFIG, "quant": "w8a16", "pp": 2}
+    )
+    np.testing.assert_array_equal(got_idx, want_idx)
+    np.testing.assert_allclose(got_scores, want_scores, atol=1e-5)
+
+
+def test_moe_w8a16_serves_and_tracks_bf16_moe():
+    """{"moe_experts": 4, "quant": "w8a16"}: expert FFNs run weight-only
+    int8 (quant.wmoe_expert) with per-expert scales. The quantized MoE must
+    (a) serve, (b) track the unquantized MoE's decisions, and (c) actually
+    differ from it bit-wise (else the transform silently skipped the
+    experts)."""
+    rt = get_runtime()
+    moe_config = {**BASE_CONFIG, "moe_experts": 4}
+    want_idx, want_scores = _classify(rt, moe_config)
+    got_idx, got_scores = _classify(rt, {**moe_config, "quant": "w8a16"})
+    top1_agree = np.mean(got_idx[:, 0] == want_idx[:, 0])
+    assert top1_agree >= 0.9, f"top-1 agreement only {top1_agree:.2f}"
+    assert not np.array_equal(got_scores, want_scores), (
+        "w8a16 MoE bit-identical to f32 MoE — experts were not quantized"
+    )
+
+
+def test_moe_w8a16_ep_sharding_matches_unsharded():
+    """The W8A16 MoE over an ep=4 mesh (per-expert int8 tables + scales
+    sharded over ep, all-to-all at dispatch/combine) equals the unsharded
+    W8A16 MoE — the same composition guarantee the int8 mode carries."""
+    moe_w8a16 = {**BASE_CONFIG, "moe_experts": 4, "quant": "w8a16"}
+    want_idx, want_scores = _classify(get_runtime(), moe_w8a16)
+    rt_ep = _mesh_runtime({"dp": 2, "ep": 4})
+    got_idx, got_scores = _classify(rt_ep, moe_w8a16)
+    np.testing.assert_array_equal(got_idx, want_idx)
+    np.testing.assert_allclose(got_scores, want_scores, atol=1e-5)
+
+
 @pytest.mark.parametrize(
     "bad_config, msg",
     [

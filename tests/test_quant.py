@@ -88,21 +88,92 @@ def test_weight_roundtrip_exact_for_representable():
 
 def test_validate_quant():
     assert quant.validate_quant("int8") == "int8"
+    assert quant.validate_quant("w8a16") == "w8a16"
     assert quant.validate_quant("none") == "none"
     with pytest.raises(ValueError, match="quant"):
         quant.validate_quant("int4")
 
 
+# ---- W8A16 weight-only kernels ----
+
+
+def test_wdense_matches_dequantized_dense():
+    """wdense must equal the plain dense over the DEQUANTIZED table — the
+    only approximation in W8A16 is the weight rounding itself (activations
+    are untouched), so against w8·scale the match is float-exact-ish."""
+    key = jax.random.PRNGKey(2)
+    k1, k2, k3 = jax.random.split(key, 3)
+    p = {
+        "w": jax.random.normal(k1, (64, 96), dtype=jnp.float32) * 0.1,
+        "b": jax.random.normal(k2, (96,), dtype=jnp.float32) * 0.01,
+    }
+    x = jax.random.normal(k3, (8, 64), dtype=jnp.float32)
+    q = quant.quantize_dense_w8a16(p)
+    assert q["w8"].dtype == np.int8
+    deq = {"w": q["w8"].astype(np.float32) * q["w_scale"], "b": q["b"]}
+    want = layers.dense(deq, x, jnp.float32)
+    got = quant.wdense(q, x, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+    # And it tracks the ORIGINAL weights within the int8 rounding budget.
+    orig = layers.dense(p, x, jnp.float32)
+    err = np.abs(np.asarray(got - orig))
+    assert err.max() <= 0.02 * np.abs(np.asarray(orig)).max() + 1e-6
+
+
+def test_wproj_in_out_close_to_einsum():
+    key = jax.random.PRNGKey(3)
+    k1, k2, k3 = jax.random.split(key, 3)
+    B, L, d, H, E = 2, 16, 32, 4, 8
+    w_in = jax.random.normal(k1, (d, H, E), dtype=jnp.float32) * 0.1
+    w_out = jax.random.normal(k2, (H, E, d), dtype=jnp.float32) * 0.1
+    x = jax.random.normal(k3, (B, L, d), dtype=jnp.float32)
+
+    want_in = jnp.einsum("bld,dhe->bhle", x, w_in)
+    got_in = quant.wproj_in(
+        quant.quantize_weight_w8a16(w_in, (0,)), x, jnp.float32
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_in), np.asarray(want_in),
+        atol=0.02 * float(jnp.abs(want_in).max()),
+    )
+
+    h = jnp.asarray(want_in)  # [B, H, L, E]
+    want_out = jnp.einsum("bhle,hed->bld", h, w_out)
+    got_out = quant.wproj_out(
+        quant.quantize_weight_w8a16(w_out, (0, 1)), h, jnp.float32
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_out), np.asarray(want_out),
+        atol=0.02 * float(jnp.abs(want_out).max()),
+    )
+
+
+def test_w8a16_leaf_conventions_are_disjoint():
+    """The two leaf predicates must never both claim a leaf — dispatch in
+    layers.dense/_proj_* relies on it."""
+    w = np.ones((4, 8), np.float32)
+    q8 = quant.quantize_weight(w, (0,))
+    w8 = quant.quantize_weight_w8a16(w, (0,))
+    assert quant.is_quantized(q8) and not quant.is_weight_only(q8)
+    assert quant.is_weight_only(w8) and not quant.is_quantized(w8)
+    # Same int8 table, same scale — only the leaf key differs.
+    np.testing.assert_array_equal(q8["w_q"], w8["w8"])
+    np.testing.assert_array_equal(q8["w_scale"], w8["w_scale"])
+
+
 # ---- model-level numerics ----
 
 
-def test_encoder_forward_int8_tracks_f32():
+@pytest.mark.parametrize("mode", ["int8", "w8a16"])
+def test_encoder_forward_quantized_tracks_f32(mode):
     cfg = encoder.EncoderConfig(
         d_model=64, n_heads=4, n_layers=3, d_ff=128, max_len=64,
         n_classes=50, dtype="float32",
     )
     params = encoder.init_params(cfg, model_id="quant-numerics")
-    qparams = quant.quantize_encoder(params)
+    qparams = quant.quantize_encoder(params, mode)
     rng = np.random.default_rng(0)
     B, L = 16, 32
     ids = rng.integers(4, 200, size=(B, L)).astype(np.int32)
@@ -357,6 +428,136 @@ def test_t5_bart_quantize_trees_close():
     # Unquantized leaves pass through untouched.
     assert qp["embed"] is params["embed"]
     assert L.count_params(params) > 0  # tree still walkable
+
+
+# ---- W8A16 op contract ----
+
+
+def test_classify_w8a16_through_op(rt):
+    from agent_tpu.ops import get_op
+
+    classify = get_op("map_classify_tpu")
+    texts = [f"w8a16 contract row {i}" for i in range(8)]
+    base = {
+        "texts": texts, "topk": 3, "model_path": "w8a16-op",
+        "allow_fallback": False, "result_format": "columnar",
+    }
+    a = classify({**base, "model_config": QCFG}, OpContext(runtime=rt))
+    b = classify(
+        {**base, "model_config": {**QCFG, "quant": "w8a16"}},
+        OpContext(runtime=rt),
+    )
+    assert a["ok"] and b["ok"]
+    assert len(b["indices"]) == len(texts) and len(b["indices"][0]) == 3
+    # w8a16 compiles/caches under its own key (distinct cfg fingerprint).
+    keys = list(rt.cache._cache.keys())
+    w_keys = [
+        k for k in keys
+        if k[0] == "map_classify_tpu" and ("quant", "w8a16") in k[-1]
+    ]
+    assert w_keys, f"no w8a16-keyed executable in {keys}"
+    top1_a = [row[0] for row in a["indices"]]
+    top1_b = [row[0] for row in b["indices"]]
+    agree = np.mean([x == y for x, y in zip(top1_a, top1_b)])
+    assert agree >= 0.75
+
+
+def test_summarize_w8a16_through_op(rt):
+    from agent_tpu.ops import get_op
+
+    summarize = get_op("map_summarize")
+    cfg = {
+        "d_model": 32, "n_heads": 4, "n_enc_layers": 1, "n_dec_layers": 1,
+        "d_ff": 64, "max_src_len": 64, "max_tgt_len": 16, "dtype": "float32",
+    }
+    payload = {
+        "texts": ["a w8a16 document about weight-only decoding " * 3] * 4,
+        "max_length": 8,
+        "num_beams": 4,  # the decode mode the W8A16 path targets
+        "model_config": {**cfg, "quant": "w8a16"},
+        "model_path": "w8a16-sum",
+    }
+    out = summarize(dict(payload), OpContext(runtime=rt))
+    assert out["ok"] is True
+    assert len(out["summaries"]) == 4
+    assert all(isinstance(s, str) for s in out["summaries"])
+    keys = [
+        k for k in rt.cache._cache.keys()
+        if k[0] == "map_summarize" and k[1] == "w8a16-sum"
+    ]
+    assert keys and all(("quant", "w8a16") in k[-1] for k in keys)
+
+
+def test_summarize_w8a16_tp_matches_replicated(rt, rt_tp):
+    from agent_tpu.ops import get_op
+
+    summarize = get_op("map_summarize")
+    cfg = {
+        "d_model": 32, "n_heads": 4, "n_enc_layers": 1, "n_dec_layers": 1,
+        "d_ff": 64, "max_src_len": 64, "max_tgt_len": 16, "dtype": "float32",
+        "quant": "w8a16",
+    }
+    payload = {
+        "texts": ["a long document about w8a16 tensor parallel " * 3] * 4,
+        "max_length": 8,
+        "model_config": cfg,
+        "model_path": "w8a16-sum-tp",
+    }
+    a = summarize(dict(payload), OpContext(runtime=rt))
+    b = summarize(dict(payload), OpContext(runtime=rt_tp))
+    assert a["ok"] and b["ok"]
+    assert a["summaries"] == b["summaries"]
+
+
+def test_w8a16_params_actually_sharded_and_int8(rt_tp):
+    """On the tp mesh the resident W8A16 tables are int8 dtype AND
+    head-sharded — the spec-tree twin transforms the same paths as int8's,
+    so the HBM-bytes win and the tp win compose."""
+    from agent_tpu.models.encoder import EncoderConfig
+    from agent_tpu.ops import get_op
+    from agent_tpu.ops._model_common import cfg_key
+
+    cfg_dict = {**QCFG, "n_heads": 8, "quant": "w8a16"}
+    get_op("map_classify_tpu")(
+        {"texts": ["w8a16 shard check"], "model_config": cfg_dict,
+         "model_path": "w8a16-shardcheck", "allow_fallback": False},
+        OpContext(runtime=rt_tp),
+    )
+    cfg = EncoderConfig(**cfg_dict)
+    key = (
+        "params",
+        f"w8a16-shardcheck#encoder#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}",
+        "tp",
+    )
+    params = rt_tp._params.get_or_build(
+        key, lambda: pytest.fail("w8a16 params not cached under the tp key")
+    )
+    wq = params["blocks"][0]["attn"]["wq"]
+    assert set(wq) == {"w8", "w_scale"}
+    assert wq["w8"].dtype == jnp.int8
+    shard = wq["w8"].sharding.shard_shape(wq["w8"].shape)
+    assert shard[1] == wq["w8"].shape[1] // 2        # heads over tp=2
+    scale_shard = wq["w_scale"].sharding.shard_shape(wq["w_scale"].shape)
+    assert scale_shard[0] == wq["w_scale"].shape[0] // 2  # scales follow
+
+
+def test_w8a16_env_switch(rt, monkeypatch):
+    """TPU_QUANT=w8a16 turns weight-only serving on without payload
+    changes — the same env path as int8."""
+    from agent_tpu.ops import get_op
+
+    monkeypatch.setenv("TPU_QUANT", "w8a16")
+    out = get_op("map_classify_tpu")(
+        {"texts": ["w8a16 env switch row"], "topk": 3, "model_config": QCFG,
+         "model_path": "w8a16-env", "allow_fallback": False},
+        OpContext(runtime=rt),
+    )
+    assert out["ok"] is True
+    keys = [
+        k for k in rt.cache._cache.keys()
+        if k[0] == "map_classify_tpu" and k[1] == "w8a16-env"
+    ]
+    assert keys and all(("quant", "w8a16") in k[-1] for k in keys)
 
 
 def test_bad_env_quant_fails_shard_not_soft(rt, monkeypatch):
