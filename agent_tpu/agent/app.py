@@ -35,9 +35,22 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from agent_tpu.config import Config
+from agent_tpu.obs.metrics import MetricsRegistry
+from agent_tpu.obs.recorder import FlightRecorder
 from agent_tpu.ops import OpFn, load_ops
 from agent_tpu.utils.errors import structured_error
 from agent_tpu.utils.logging import RateLimiter, log
+
+# result-timings key → task_phase_seconds phase label. The ops stamp
+# milliseconds into ctx.tags["timings"] (see map_classify_tpu.finalize);
+# the loops turn them into histogram observations in seconds.
+PHASE_KEYS = (
+    ("stage_ms", "stage"),
+    ("queue_ms", "queue"),
+    ("device_ms", "execute"),
+    ("fetch_ms", "fetch"),
+    ("finalize_ms", "finalize"),
+)
 
 STATUS_TRANSPORT_ERROR = 0  # "could not reach the controller at all"
 
@@ -71,6 +84,8 @@ class Agent:
         config: Optional[Config] = None,
         session: Any = None,
         runtime: Any = None,
+        registry: Any = None,
+        recorder: Any = None,
     ) -> None:
         self.config = config or Config.from_env()
         if session is None:
@@ -81,6 +96,37 @@ class Agent:
         self.runtime = runtime
         self.running = True
         self.rate = RateLimiter(self.config.agent.error_log_every_sec)
+        # Observability (ISSUE 2): an OWN registry/recorder per agent — the
+        # controller often shares the process (tests, bench) and the fleet
+        # merge must not double-count series. The snapshot ships to the
+        # controller inside every lease's ``metrics`` dict.
+        self.obs: MetricsRegistry = (
+            registry if registry is not None else MetricsRegistry()
+        )
+        self.recorder: FlightRecorder = (
+            recorder if recorder is not None else FlightRecorder()
+        )
+        self.m_tasks = self.obs.counter(
+            "tasks_total", "Tasks completed by op and status",
+            ("op", "status"))
+        self.m_phase = self.obs.histogram(
+            "task_phase_seconds",
+            "Per-task phase latency (stage/queue/execute/fetch/finalize)",
+            ("op", "phase"))
+        self.m_lease = self.obs.counter(
+            "lease_requests_total", "Lease polls by outcome", ("outcome",))
+        self.m_queue = self.obs.gauge(
+            "queue_depth", "Pipeline queue occupancy (staged/post)",
+            ("queue",))
+        self.m_device_idle = self.obs.counter(
+            "device_idle_seconds_total",
+            "Device-thread seconds blocked waiting for staged work")
+        self.m_device_busy = self.obs.counter(
+            "device_busy_seconds_total",
+            "Device-thread seconds dispatching op execute phases")
+        # Periodic progress-summary state (the per-task "task done" line is
+        # rate-limited away: one line per task floods stdout at drain scale).
+        self._progress = {"t": time.monotonic(), "n": 0}
         # Multi-host: join the coordination service BEFORE anything touches a
         # jax backend (sizing probes jax.devices()); jax.distributed must be
         # first or it refuses and the slice desyncs.
@@ -130,7 +176,68 @@ class Agent:
                 m["device"] = self.runtime.describe()
             except Exception:  # noqa: BLE001 — telemetry must never kill a lease
                 pass
+        try:
+            # The fleet channel: the controller keys this snapshot by agent
+            # id and merges the fleet into GET /v1/metrics.
+            m["obs"] = self.obs.snapshot()
+        except Exception:  # noqa: BLE001 — telemetry must never kill a lease
+            pass
         return m
+
+    def push_metrics(self, session: Any = None) -> bool:
+        """Metrics-only lease poll (``max_tasks=0`` — the controller records
+        telemetry and leases nothing). Drain loops call this after the last
+        result posts so the final counters reach the fleet view; best-effort
+        by contract."""
+        try:
+            a = self.config.agent
+            status, _ = self._post_json(
+                "/v1/leases",
+                {
+                    "agent": a.agent_name,
+                    "capabilities": {"ops": []},
+                    "max_tasks": 0,
+                    "labels": a.labels,
+                    "metrics": self._metrics(),
+                },
+                session=session,
+            )
+            return status in (200, 204)
+        except Exception:  # noqa: BLE001 — flush must never fail a drain
+            return False
+
+    def record_phase_timings(
+        self, op: str, timings: Optional[Dict[str, Any]],
+        keys: Optional[Tuple[str, ...]] = None,
+    ) -> None:
+        """ctx.tags["timings"] (milliseconds) → ``task_phase_seconds``
+        observations. ``keys`` restricts which timing keys count — the
+        pipelined runner measures stage/execute/finalize wall-clock itself
+        and only takes queue/fetch from the op timings (observing both would
+        double-count)."""
+        for key, phase in PHASE_KEYS:
+            if keys is not None and key not in keys:
+                continue
+            v = (timings or {}).get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.m_phase.observe(float(v) / 1000.0, op=op, phase=phase)
+
+    def note_progress(self, queues: Optional[Dict[str, int]] = None) -> None:
+        """Periodic progress summary (tasks/sec over the window, queue
+        depths), rate-limited on the shared ``RateLimiter`` — the drain-scale
+        replacement for one log line per task."""
+        if not self.rate.ready("progress"):
+            return
+        now = time.monotonic()
+        dt = now - self._progress["t"]
+        dn = self.tasks_done - self._progress["n"]
+        self._progress = {"t": now, "n": self.tasks_done}
+        fields: Dict[str, Any] = {"tasks_done": self.tasks_done}
+        if dt > 0:
+            fields["tasks_per_sec"] = round(dn / dt, 3)
+        if queues:
+            fields.update(queues)
+        log("progress", **fields)
 
     def lease_once(self) -> Optional[Tuple[str, List[Dict[str, Any]]]]:
         """One ``/v1/leases`` round-trip → ``(lease_id, tasks)`` or None when
@@ -150,17 +257,29 @@ class Agent:
             },
         )
         if status == STATUS_TRANSPORT_ERROR:
+            self.m_lease.inc(outcome="error")
             raise RuntimeError(f"lease transport error: {body}")
         if status == 204:
+            self.m_lease.inc(outcome="idle")
             return None
         if status != 200 or not isinstance(body, dict):
+            self.m_lease.inc(outcome="error")
             raise RuntimeError(f"lease HTTP {status}: {str(body)[:200]}")
         tasks = body.get("tasks")
         lease_id = body.get("lease_id")
         if not tasks:
+            self.m_lease.inc(outcome="idle")
             return None
         if not isinstance(lease_id, str) or not isinstance(tasks, list):
+            self.m_lease.inc(outcome="error")
             raise RuntimeError(f"malformed lease response: {str(body)[:200]}")
+        self.m_lease.inc(outcome="tasks")
+        self.recorder.record(
+            "lease", lease_id=lease_id, n_tasks=len(tasks),
+            job_ids=[
+                t.get("id") for t in tasks if isinstance(t, dict)
+            ],
+        )
         return lease_id, tasks
 
     def post_result(
@@ -214,11 +333,18 @@ class Agent:
             raise ValueError("task payload must be a dict")
         return job_id, op, payload, epoch
 
-    def _op_context(self, job_id: str):
+    def _op_context(self, job_id: str, lease_id: Optional[str] = None,
+                    attempt: Any = None):
         from agent_tpu.runtime.context import OpContext
 
+        # The trace triple stamped at lease time (ISSUE 2 tentpole 5): it
+        # rides ctx.tags into op timings/logs and is copied into the result
+        # body, so one job's life greps across controller journal, agent
+        # logs, and both flight recorders.
+        trace = {"job_id": job_id, "attempt": attempt, "lease_id": lease_id}
         return OpContext(
-            runtime=self.runtime, config=self.config, tags={"job_id": job_id}
+            runtime=self.runtime, config=self.config,
+            tags={"job_id": job_id, "trace": trace},
         )
 
     def profiled_call(self, op: str, thunk: Any) -> Any:
@@ -280,14 +406,21 @@ class Agent:
         """
         t0 = time.perf_counter()
         job_id, op, payload, epoch, fn, resolve_error = self.resolve_task(task)
+        attempt = task.get("attempt") if isinstance(task, dict) else None
         if resolve_error is not None:
             if job_id is not None:
+                self.m_tasks.inc(op=op, status="failed")
+                self.recorder.record(
+                    "task", job_id=job_id, op=op, status="failed",
+                    lease_id=lease_id, attempt=attempt,
+                    error_type=resolve_error.get("type"),
+                )
                 self.post_result(
                     lease_id, job_id, epoch, "failed", error=resolve_error
                 )
             return
 
-        ctx = self._op_context(job_id)
+        ctx = self._op_context(job_id, lease_id=lease_id, attempt=attempt)
         try:
             # Multi-host: every host must enter the same SPMD program in
             # lockstep — the leader publishes the task before executing it
@@ -318,10 +451,19 @@ class Agent:
             result.setdefault("duration_ms", duration_ms)
             if ctx.tags.get("timings"):
                 result.setdefault("timings", ctx.tags["timings"])
+            result.setdefault("trace", ctx.tags.get("trace"))
         self.post_result(lease_id, job_id, epoch, status, result=result, error=error)
         self.tasks_done += 1
-        log("task done", op=op, job_id=job_id, status=status,
-            duration_ms=round(duration_ms, 3))
+        self.m_tasks.inc(op=op, status=status)
+        # Serial phases come from the op's own timings (the monolithic call
+        # gives this loop no phase boundaries of its own to measure).
+        self.record_phase_timings(op, ctx.tags.get("timings"))
+        self.recorder.record(
+            "task", job_id=job_id, op=op, status=status, lease_id=lease_id,
+            attempt=attempt, duration_ms=round(duration_ms, 3),
+            error_type=(error or {}).get("type") if error else None,
+        )
+        self.note_progress()
 
     # ---- main loop ----
 
@@ -441,6 +583,10 @@ class Agent:
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
+        # Final telemetry flush: the last task's counters postdate the last
+        # real lease poll, so without this the fleet view would always lag
+        # one snapshot behind a finished drain.
+        self.push_metrics()
         # Clean exit only: after an op exception the followers are desynced
         # or dead, and the shutdown broadcast is itself a collective —
         # entering it would recreate the silent slice hang the lockstep
@@ -472,13 +618,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     signal.signal(signal.SIGINT, agent.shutdown)
     signal.signal(signal.SIGTERM, agent.shutdown)
+    # Flight recorder taps: SIGUSR1 dumps the ring on demand; a fatal error
+    # dumps it before the process dies — a wedged drain is diagnosable after
+    # the fact without re-running it under extra logging.
+    from agent_tpu.obs.recorder import default_dump_path, install_sigusr1_dump
+
+    dump_path = default_dump_path(f"agent-{config.agent.agent_name}")
+    if install_sigusr1_dump(agent.recorder, dump_path):
+        log("flight recorder armed", signal="SIGUSR1", path=dump_path)
     log(
         "agent up",
         agent=config.agent.agent_name,
         controller=config.agent.controller_url,
         ops=sorted(agent.handlers),
     )
-    agent.run()
+    try:
+        agent.run()
+    except BaseException:
+        try:
+            n = agent.recorder.dump(dump_path)
+            log("fatal error — flight recorder dumped",
+                path=dump_path, events=n)
+        except OSError:
+            pass
+        raise
     log("agent drained", tasks_done=agent.tasks_done)
     return 0
 

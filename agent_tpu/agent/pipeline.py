@@ -107,6 +107,7 @@ class PipelineRunner:
         # Shared resolution (Agent.resolve_task): malformed-task salvage and
         # the UnknownOp shape are single-sourced with the serial loop.
         job_id, op, payload, epoch, fn, resolve_error = agent.resolve_task(task)
+        attempt = task.get("attempt") if isinstance(task, dict) else None
         if resolve_error is not None:
             if job_id is None:
                 return None
@@ -117,7 +118,8 @@ class PipelineRunner:
 
         item = _Item(
             lease_id, job_id, epoch, op, payload,
-            agent._op_context(job_id), t0, fn=fn,
+            agent._op_context(job_id, lease_id=lease_id, attempt=attempt),
+            t0, fn=fn,
         )
         stage = getattr(fn, "stage", None)
         if stage is None:
@@ -129,7 +131,19 @@ class PipelineRunner:
             item.status = "failed"
             item.error = structured_error(exc)
             agent.rate.log("exec", "stage raised", op=op, type=type(exc).__name__)
+            agent.recorder.record(
+                "error", phase="stage", job_id=job_id, op=op,
+                lease_id=lease_id, attempt=attempt,
+                type=type(exc).__name__, message=str(exc)[:200],
+            )
             return item
+        agent.m_phase.observe(
+            time.perf_counter() - t0, op=op, phase="stage"
+        )
+        agent.recorder.record(
+            "phase", phase="staged", job_id=job_id, op=op,
+            lease_id=lease_id, attempt=attempt,
+        )
         if phase == "done":
             item.result = value
         else:
@@ -168,6 +182,7 @@ class PipelineRunner:
         while True:
             try:
                 self.staged_q.put(item, timeout=0.5)
+                self.agent.m_queue.set(self.staged_q.qsize(), queue="staged")
                 return
             except queue.Full:
                 if not self.agent.running:
@@ -188,6 +203,7 @@ class PipelineRunner:
         while True:
             try:
                 self.post_q.put(item, timeout=0.5)
+                self.agent.m_queue.set(self.post_q.qsize(), queue="post")
                 return True
             except queue.Full:
                 if not self._poster.is_alive():
@@ -207,12 +223,19 @@ class PipelineRunner:
         agent = self.agent
         try:
             while True:
+                # Busy/idle attribution (the tf.data question — is the input
+                # stage or the accelerator the limiter?): time blocked here
+                # is device idle; time inside the op dispatch is device busy.
+                t_wait = time.perf_counter()
                 item = self.staged_q.get()
+                agent.m_device_idle.inc(time.perf_counter() - t_wait)
                 if item is _STOP:
                     break
+                agent.m_queue.set(self.staged_q.qsize(), queue="staged")
                 if item.result is not None or item.status == "failed":
                     self._put_post(item)
                     continue
+                t_exec = time.perf_counter()
                 try:
                     # profiled_call covers phased ops too — PROFILE_DIR
                     # traces capture the device phase either way (§5.1).
@@ -231,6 +254,19 @@ class PipelineRunner:
                     item.error = structured_error(exc)
                     agent.rate.log("exec", "op raised", op=item.op,
                                    type=type(exc).__name__)
+                    agent.recorder.record(
+                        "error", phase="execute", job_id=item.job_id,
+                        op=item.op, lease_id=item.lease_id,
+                        type=type(exc).__name__, message=str(exc)[:200],
+                    )
+                dt = time.perf_counter() - t_exec
+                agent.m_device_busy.inc(dt)
+                agent.m_phase.observe(dt, op=item.op, phase="execute")
+                agent.recorder.record(
+                    "phase", phase="executed", job_id=item.job_id,
+                    op=item.op, lease_id=item.lease_id,
+                    status=item.status,
+                )
                 self._put_post(item)
         finally:
             self._put_post(_STOP)  # same lost-sentinel guard as the stager
@@ -252,6 +288,8 @@ class PipelineRunner:
             item = self.post_q.get()
             if item is _STOP:
                 break
+            agent.m_queue.set(self.post_q.qsize(), queue="post")
+            t_fin = time.perf_counter()
             try:
                 if item.executed is not None:
                     item.result = item.fn.finalize(item.executed, item.ctx)
@@ -259,20 +297,52 @@ class PipelineRunner:
                 item.status = "failed"
                 item.error = structured_error(exc)
                 item.result = None
+                agent.recorder.record(
+                    "error", phase="finalize", job_id=item.job_id,
+                    op=item.op, lease_id=item.lease_id,
+                    type=type(exc).__name__, message=str(exc)[:200],
+                )
+            finalize_s = time.perf_counter() - t_fin
+            agent.m_phase.observe(finalize_s, op=item.op, phase="finalize")
             duration_ms = (time.perf_counter() - item.t_start) * 1000.0
+            if item.ctx is not None:
+                timings = item.ctx.tags.setdefault("timings", {})
+                # Stamped here because finalize cannot time its own return;
+                # rides the result body so scrape-side attribution sees the
+                # poster-thread cost too.
+                timings["finalize_ms"] = round(finalize_s * 1000.0, 3)
+                # queue/fetch come from the op's own timings; stage/execute/
+                # finalize were measured wall-clock by the runner threads
+                # (observing both views would double-count those phases).
+                agent.record_phase_timings(
+                    item.op, timings, keys=("queue_ms", "fetch_ms")
+                )
             if isinstance(item.result, dict):
                 item.result.setdefault("duration_ms", duration_ms)
-                if item.ctx is not None and item.ctx.tags.get("timings"):
-                    item.result.setdefault("timings", item.ctx.tags["timings"])
+                if item.ctx is not None:
+                    if item.ctx.tags.get("timings"):
+                        item.result.setdefault(
+                            "timings", item.ctx.tags["timings"]
+                        )
+                    item.result.setdefault(
+                        "trace", item.ctx.tags.get("trace")
+                    )
             agent.post_result(
                 item.lease_id, item.job_id, item.epoch, item.status,
                 result=item.result, error=item.error, session=session,
             )
             self.tasks_posted += 1
             agent.tasks_done += 1
-            log("task done", op=item.op, job_id=item.job_id,
-                status=item.status, duration_ms=round(duration_ms, 3),
-                pipelined=True)
+            agent.m_tasks.inc(op=item.op, status=item.status)
+            agent.recorder.record(
+                "phase", phase="posted", job_id=item.job_id, op=item.op,
+                lease_id=item.lease_id, status=item.status,
+                duration_ms=round(duration_ms, 3),
+            )
+            agent.note_progress(queues={
+                "staged_q": self.staged_q.qsize(),
+                "post_q": self.post_q.qsize(),
+            })
 
     # ---- lifecycle ----
 
@@ -292,4 +362,8 @@ class PipelineRunner:
             self.agent.running = False
             self._stager.join(timeout=30)
             self._poster.join(timeout=30)
+            # Final telemetry flush (metrics-only lease): the last shard's
+            # finalize postdates the stager's last real poll, so without
+            # this the fleet view would miss the drain's tail.
+            self.agent.push_metrics()
         log("pipelined drain stopped", tasks_posted=self.tasks_posted)

@@ -48,6 +48,13 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from agent_tpu.config import TRUTHY_TOKENS
+from agent_tpu.obs.metrics import (
+    MetricsRegistry,
+    histogram_quantile,
+    merge_snapshots,
+    render_snapshots,
+)
+from agent_tpu.obs.recorder import FlightRecorder
 
 PENDING = "pending"
 LEASED = "leased"
@@ -81,6 +88,8 @@ class Job:
     lease_deadline: float = 0.0
     agent: Optional[str] = None
     attempts: int = 0
+    # Controller-clock submit time (queue-wait attribution: submit→lease).
+    submitted_at: float = 0.0
     # Jobs that must complete before this one becomes leasable (reduce
     # stages). ``after_order`` preserves submission order for partials
     # materialization (shard-10 must not precede shard-2); ``after`` is the
@@ -98,6 +107,10 @@ class Job:
             "op": self.op,
             "payload": self.payload,
             "job_epoch": self.epoch,
+            # Trace propagation (ISSUE 2): the agent stamps {job_id, attempt,
+            # lease_id} into ctx.tags and result bodies, so one job's life
+            # greps across journal, agent logs, and both flight recorders.
+            "attempt": self.attempts,
         }
 
 
@@ -108,6 +121,8 @@ class Controller:
         clock: Callable[[], float] = time.monotonic,
         journal_path: Optional[str] = None,
         sweep_interval_sec: Optional[float] = None,
+        registry: Optional[MetricsRegistry] = None,
+        recorder: Optional[FlightRecorder] = None,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
         self._clock = clock
@@ -118,6 +133,40 @@ class Controller:
         self.stale_results = 0
         self.last_metrics: Dict[str, Any] = {}
         self.last_profile: Dict[str, Any] = {}
+        # Observability (ISSUE 2): an OWN registry/recorder per controller —
+        # agents frequently share the process (tests, bench) and must not
+        # conflate their series with the scheduler's.
+        self.metrics = registry if registry is not None else MetricsRegistry()
+        self.recorder = recorder if recorder is not None else FlightRecorder()
+        # Per-agent telemetry keyed by agent id (replaces the overwritten
+        # last_metrics as the fleet source of truth; last_metrics is kept as
+        # the legacy /v1/status field). Each entry: {last_seen_wall, metrics
+        # (sans obs), obs (the agent's registry snapshot)}.
+        self.agent_metrics: Dict[str, Dict[str, Any]] = {}
+        self._started_wall = time.time()
+        m = self.metrics
+        self._m_lease = m.counter(
+            "controller_lease_requests_total",
+            "Lease requests by outcome", ("outcome",))
+        self._m_tasks_leased = m.counter(
+            "controller_tasks_leased_total", "Tasks handed out", ("op",))
+        self._m_results = m.counter(
+            "controller_results_total",
+            "Result posts by op and outcome (succeeded/failed/stale_epoch/"
+            "duplicate/unknown_job)", ("op", "outcome"))
+        self._m_retries = m.counter(
+            "controller_retries_total",
+            "Failed jobs re-queued for their one retry", ("op",))
+        self._m_expirations = m.counter(
+            "controller_lease_expirations_total",
+            "Leases TTL-expired and re-queued", ("op",))
+        self._m_journal_writes = m.counter(
+            "controller_journal_writes_total", "Journal appends", ("ev",))
+        self._m_queue_wait = m.histogram(
+            "controller_queue_wait_seconds",
+            "submit -> first lease latency", ("op",))
+        self._m_queue_depth = m.gauge(
+            "controller_queue_depth", "Leasable (pending) jobs")
         # The most recent profile that actually carried a TPU sizing hint —
         # kept separately because in a mixed fleet every leasing agent
         # overwrites last_profile, and a CPU agent's poll must not revert
@@ -145,6 +194,7 @@ class Controller:
         if self._journal_file is not None:
             self._journal_file.write(json.dumps(event) + "\n")
             self._journal_file.flush()
+            self._m_journal_writes.inc(ev=str(event.get("ev", "?")))
 
     def _replay_journal(self, path: str) -> None:
         """Rebuild job state from a previous incarnation's journal. Runs
@@ -281,8 +331,11 @@ class Controller:
         with self._lock:
             if job_id in self._jobs:
                 raise ValueError(f"duplicate job id {job_id!r}")
+            job.submitted_at = self._clock()
             self._jobs[job_id] = job
             self._queue.append(job_id)
+            self._m_queue_depth.set(len(self._queue))
+            self.recorder.record("submit", job_id=job_id, op=op)
             self._depended_on.update(after_order)
             self._journal(
                 {
@@ -403,6 +456,12 @@ class Controller:
                 job.state = PENDING
                 job.lease_id = None
                 self._queue.append(job.job_id)
+                self._m_expirations.inc(op=job.op)
+                self._m_queue_depth.set(len(self._queue))
+                self.recorder.record(
+                    "lease_expired", job_id=job.job_id, op=job.op,
+                    epoch=job.epoch, agent=job.agent,
+                )
                 self._journal(
                     {"ev": "requeue", "job_id": job.job_id, "epoch": job.epoch}
                 )
@@ -458,25 +517,49 @@ class Controller:
         labels: Optional[Dict[str, Any]] = None,
         **_ignored: Any,
     ) -> Optional[Dict[str, Any]]:
-        """One lease request → ``{lease_id, tasks}`` or None (HTTP 204)."""
+        """One lease request → ``{lease_id, tasks}`` or None (HTTP 204).
+
+        ``max_tasks < 1`` is a **metrics-only poll**: the agent's telemetry
+        is recorded (per-agent snapshot, profile) but nothing leases — the
+        flush channel drain loops use to push their final counters after the
+        last task posts (old agents always send ≥ 1, so the wire contract
+        is unchanged for them).
+        """
         ops = set((capabilities or {}).get("ops") or [])
         labels = labels or {}
         with self._lock:
+            now_wall = time.time()
             if metrics:
                 self.last_metrics = metrics
+                if agent:
+                    self.agent_metrics[agent] = {
+                        "last_seen_wall": now_wall,
+                        "metrics": {
+                            k: v for k, v in metrics.items() if k != "obs"
+                        },
+                        "obs": metrics.get("obs"),
+                    }
+            elif agent and agent in self.agent_metrics:
+                self.agent_metrics[agent]["last_seen_wall"] = now_wall
             if worker_profile:
                 self.last_profile = worker_profile
                 tpu = worker_profile.get("tpu") or {}
                 if isinstance(tpu, dict) and tpu.get("suggested_shard_rows"):
                     self._last_tpu_profile = worker_profile
             self._expire_leases_locked()
+            if max_tasks < 1:
+                self._m_lease.inc(outcome="metrics_only")
+                return None
             if self._take_fault("drop_lease"):
+                self._m_lease.inc(outcome="fault_drop")
+                self.recorder.record("fault", fault="drop_lease", agent=agent)
                 return None
             duplicate = self._take_fault("duplicate_task")
             stale = self._take_fault("stale_epoch")
 
             lease_id = f"lease-{uuid.uuid4().hex[:12]}"
-            deadline = self._clock() + self.lease_ttl_sec
+            now = self._clock()
+            deadline = now + self.lease_ttl_sec
             tasks: List[Dict[str, Any]] = []
             remaining: List[str] = []
             for job_id in self._queue:
@@ -493,6 +576,19 @@ class Controller:
                     job.lease_deadline = deadline
                     job.agent = agent
                     job.attempts += 1
+                    self._m_tasks_leased.inc(op=job.op)
+                    if job.attempts == 1:
+                        # Queue-wait attribution: submit → FIRST lease only
+                        # (a retry's wait measures failure handling, not
+                        # scheduling pressure).
+                        self._m_queue_wait.observe(
+                            max(0.0, now - job.submitted_at), op=job.op
+                        )
+                    self.recorder.record(
+                        "lease", job_id=job.job_id, op=job.op,
+                        lease_id=lease_id, agent=agent, epoch=job.epoch,
+                        attempt=job.attempts,
+                    )
                     if job.payload.pop("__collect_partials__", None):
                         # Reduce-time materialization: dependency results
                         # become the op's partials (kept out of the payload
@@ -515,11 +611,17 @@ class Controller:
                         # arrives carrying the old epoch and is discarded.
                         job.epoch += 1
                         stale = False
+                        self.recorder.record(
+                            "fault", fault="stale_epoch", job_id=job.job_id
+                        )
                 else:
                     remaining.append(job_id)
             self._queue = remaining
+            self._m_queue_depth.set(len(self._queue))
             if not tasks:
+                self._m_lease.inc(outcome="idle")
                 return None
+            self._m_lease.inc(outcome="granted")
             return {"lease_id": lease_id, "tasks": tasks}
 
     def report(
@@ -536,12 +638,31 @@ class Controller:
         with self._lock:
             job = self._jobs.get(job_id)
             if job is None:
+                self._m_results.inc(op="?", outcome="unknown_job")
+                self.recorder.record(
+                    "result_rejected", job_id=job_id, reason="unknown job",
+                    lease_id=lease_id,
+                )
                 return {"accepted": False, "reason": "unknown job"}
             if job_epoch != job.epoch:
+                # The epoch fence doing its job — a real counter now
+                # (``controller_results_total{outcome="stale_epoch"}``), not
+                # just the legacy attribute.
                 self.stale_results += 1
+                self._m_results.inc(op=job.op, outcome="stale_epoch")
+                self.recorder.record(
+                    "epoch_fence", job_id=job_id, op=job.op,
+                    posted_epoch=job_epoch, current_epoch=job.epoch,
+                    lease_id=lease_id, attempt=job.attempts,
+                )
                 return {"accepted": False, "reason": "stale epoch"}
             if job.state == SUCCEEDED:
                 # Duplicate completion (e.g. duplicate_task fault): first wins.
+                self._m_results.inc(op=job.op, outcome="duplicate")
+                self.recorder.record(
+                    "result_rejected", job_id=job_id, op=job.op,
+                    reason="already complete", lease_id=lease_id,
+                )
                 return {"accepted": False, "reason": "already complete"}
             # result/error before state: unlocked readers keying on a
             # terminal state must never see it paired with a stale result.
@@ -549,6 +670,16 @@ class Controller:
             job.error = error
             job.state = SUCCEEDED if status == "succeeded" else FAILED
             job.lease_id = lease_id
+            self._m_results.inc(
+                op=job.op,
+                outcome="succeeded" if job.state == SUCCEEDED else "failed",
+            )
+            self.recorder.record(
+                "result", job_id=job_id, op=job.op, state=job.state,
+                epoch=job.epoch, attempt=job.attempts, lease_id=lease_id,
+                error_type=(error or {}).get("type")
+                if isinstance(error, dict) else None,
+            )
             if job.state == FAILED:
                 # Failed jobs are re-queued once more before sticking failed —
                 # transient op errors (device warmup, fallback) get one retry.
@@ -556,6 +687,11 @@ class Controller:
                     job.state = PENDING
                     job.epoch += 1
                     self._queue.append(job.job_id)
+                    self._m_retries.inc(op=job.op)
+                    self._m_queue_depth.set(len(self._queue))
+                    self.recorder.record(
+                        "retry", job_id=job_id, op=job.op, epoch=job.epoch
+                    )
             # Journal the post-decision state (not the raw report): replay
             # applies it verbatim, so a failed-then-requeued job replays as
             # pending at the bumped epoch and a completed shard stays done.
@@ -621,3 +757,112 @@ class Controller:
                 for j in self._jobs.values()
                 if j.state == SUCCEEDED
             }
+
+    # ---- observability surface (GET /v1/metrics, /v1/status) ----
+
+    def counts_by_op(self) -> Dict[str, Dict[str, int]]:
+        """``{op: {state: n}}`` — the per-op breakdown /v1/status exposes."""
+        with self._lock:
+            out: Dict[str, Dict[str, int]] = {}
+            for job in self._jobs.values():
+                per = out.setdefault(job.op, {})
+                per[job.state] = per.get(job.state, 0) + 1
+            return out
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def agents_summary(self) -> Dict[str, Any]:
+        """Per-agent liveness: seconds since the last lease poll plus the
+        light host/device telemetry it pushed (sans the obs snapshot — that
+        feeds /v1/metrics, not status JSON)."""
+        now = time.time()
+        with self._lock:
+            entries = {
+                a: (e.get("last_seen_wall", 0.0), e.get("metrics") or {})
+                for a, e in self.agent_metrics.items()
+            }
+        return {
+            a: {
+                "last_seen_sec_ago": round(max(0.0, now - seen), 3),
+                "metrics": m,
+            }
+            for a, (seen, m) in entries.items()
+        }
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        """Per-agent obs snapshots summed into fleet totals."""
+        with self._lock:
+            snaps = [
+                e.get("obs") for e in self.agent_metrics.values()
+                if isinstance(e.get("obs"), dict)
+            ]
+        return merge_snapshots(snaps)
+
+    def metrics_text(self) -> str:
+        """The full Prometheus exposition: controller series, fleet-merged
+        agent series, and a synthetic per-agent liveness gauge. Agent metric
+        names never collide with the ``controller_``-prefixed families, so
+        one flat exposition stays valid."""
+        liveness = {
+            "agent_last_seen_seconds": {
+                "type": "gauge",
+                "help": "Seconds since each agent's last lease poll",
+                "labels": ["agent"],
+                "series": [
+                    {"labels": {"agent": a}, "value": s["last_seen_sec_ago"]}
+                    for a, s in self.agents_summary().items()
+                ],
+            }
+        }
+        return render_snapshots([
+            (self.metrics.snapshot(), {}),
+            (self.fleet_snapshot(), {}),
+            (liveness, {}),
+        ])
+
+    def status_summary(self) -> Dict[str, Any]:
+        """Structured rollup for /v1/status: per-op task counts + throughput
+        since controller start, and p50/p95/p99 per task phase estimated
+        from the fleet-merged ``task_phase_seconds`` histogram buckets."""
+        uptime = max(1e-9, time.time() - self._started_wall)
+        snap = self.metrics.snapshot()
+        per_op: Dict[str, Dict[str, Any]] = {}
+        for s in snap.get("controller_results_total", {}).get("series", []):
+            labels = s.get("labels", {})
+            op, outcome = labels.get("op"), labels.get("outcome")
+            if op is None or outcome not in ("succeeded", "failed"):
+                continue
+            entry = per_op.setdefault(op, {"succeeded": 0, "failed": 0})
+            entry[outcome] = int(s.get("value", 0))
+        for op, entry in per_op.items():
+            entry["tasks_per_sec"] = round(entry["succeeded"] / uptime, 3)
+        phases: Dict[str, Dict[str, Any]] = {}
+        fleet = self.fleet_snapshot().get("task_phase_seconds")
+        if fleet:
+            buckets = fleet.get("buckets", [])
+            for s in fleet.get("series", []):
+                labels = s.get("labels", {})
+                op, phase = labels.get("op"), labels.get("phase")
+                if op is None or phase is None or not s.get("count"):
+                    continue
+                qs = {
+                    f"p{int(q * 100)}": histogram_quantile(
+                        buckets, s.get("counts", []), q
+                    )
+                    for q in (0.5, 0.95, 0.99)
+                }
+                phases.setdefault(op, {})[phase] = {
+                    "count": s["count"],
+                    "sum_seconds": round(float(s.get("sum", 0.0)), 6),
+                    **{
+                        k: (round(v, 6) if v is not None else None)
+                        for k, v in qs.items()
+                    },
+                }
+        return {
+            "uptime_sec": round(uptime, 3),
+            "ops": per_op,
+            "task_phase_seconds": phases,
+        }

@@ -37,8 +37,16 @@ class _Handler(BaseHTTPRequestHandler):
         if body is None:
             self.end_headers()
             return
-        data = json.dumps(body).encode()
+        data = json.dumps(body, default=str).encode()
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_text(self, status: int, text: str, content_type: str) -> None:
+        data = text.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -49,10 +57,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"error": "invalid JSON body"})
             return
         if self.path == "/v1/leases":
+            try:
+                # max_tasks=0 is a metrics-only poll (the drain-end flush
+                # channel) — it must NOT coerce to 1 like the old `or 1` did.
+                raw_max = body.get("max_tasks")
+                max_tasks = 1 if raw_max is None else int(raw_max)
+            except (TypeError, ValueError):
+                self._send(400, {"error": "max_tasks must be an int"})
+                return
             lease = self.controller.lease(
                 agent=str(body.get("agent", "")),
                 capabilities=body.get("capabilities"),
-                max_tasks=int(body.get("max_tasks", 1) or 1),
+                max_tasks=max_tasks,
                 worker_profile=body.get("worker_profile"),
                 metrics=body.get("metrics"),
                 labels=body.get("labels")
@@ -112,9 +128,32 @@ class _Handler(BaseHTTPRequestHandler):
                 200,
                 {
                     "counts": self.controller.counts(),
+                    "counts_by_op": self.controller.counts_by_op(),
+                    "queue_depth": self.controller.queue_depth(),
                     "drained": self.controller.drained(),
                     "stale_results": self.controller.stale_results,
+                    "agents": self.controller.agents_summary(),
+                    "summary": self.controller.status_summary(),
                     "last_metrics": self.controller.last_metrics,
+                },
+            )
+        elif self.path == "/v1/metrics":
+            # Prometheus text exposition: controller series + fleet-merged
+            # agent series + per-agent liveness (see Controller.metrics_text).
+            self._send_text(
+                200,
+                self.controller.metrics_text(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        elif self.path == "/v1/debug/events":
+            # Flight-recorder dump on demand — the controller half of the
+            # post-hoc diagnosis story (the agent half is SIGUSR1).
+            self._send(
+                200,
+                {
+                    "events": self.controller.recorder.events(),
+                    "dropped": self.controller.recorder.dropped,
+                    "capacity": self.controller.recorder.capacity,
                 },
             )
         elif self.path.startswith("/v1/jobs/"):

@@ -1,0 +1,47 @@
+"""Swarm-wide observability: metrics registry, flight recorder, scraping.
+
+Dependency-free by design (ISSUE 2): counters/gauges/histograms with labels
+rendered to Prometheus text by string formatting, a bounded ring buffer of
+structured events for post-hoc diagnosis, and the parse/validate helpers the
+scrape side (bench, CI smoke) uses. Agent and controller each own injectable
+instances; ``get_registry()``/``get_recorder()`` are the process-global
+defaults for standalone callers.
+"""
+
+from agent_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    histogram_quantile,
+    merge_snapshots,
+    parse_exposition,
+    render_snapshots,
+    validate_exposition,
+)
+from agent_tpu.obs.recorder import (
+    FlightRecorder,
+    default_dump_path,
+    get_recorder,
+    install_sigusr1_dump,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "FlightRecorder",
+    "get_registry",
+    "get_recorder",
+    "histogram_quantile",
+    "merge_snapshots",
+    "parse_exposition",
+    "render_snapshots",
+    "validate_exposition",
+    "default_dump_path",
+    "install_sigusr1_dump",
+]

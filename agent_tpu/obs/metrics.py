@@ -1,0 +1,589 @@
+"""Dependency-free metrics: counters, gauges, fixed-bucket histograms.
+
+The swarm's observability substrate (ISSUE 2): every layer records into a
+``MetricsRegistry`` — thread-safe, label-aware, renderable to the Prometheus
+text exposition format with nothing but string formatting (no client
+library; the container must not grow a dependency for counting).
+
+Three deliberate shapes:
+
+- **Injectable instances.** The agent and the controller each own a registry
+  (they frequently share a process in tests and bench — one global would
+  conflate ``tasks_total`` as seen by the agent with the controller's view).
+  A process-global default (``get_registry()``) exists for standalone
+  callers and scripts.
+- **Snapshots are the wire format.** ``registry.snapshot()`` is a plain
+  JSON-able dict; agents push it to the controller inside the lease
+  ``metrics`` channel, and ``merge_snapshots`` sums per-agent snapshots into
+  the fleet aggregate that ``GET /v1/metrics`` exposes next to the
+  controller's own series. Counters and histograms sum; gauges sum too
+  (fleet queue depth is the sum of per-agent depths).
+- **Fixed buckets.** Histograms carry their bucket bounds in the snapshot,
+  so merge and quantile estimation (``histogram_quantile``) need no shared
+  config. Bounds are seconds-oriented (5 ms .. 5 min) — per-task phase
+  latencies, lease waits.
+
+``parse_exposition`` / ``validate_exposition`` close the loop: bench and
+``scripts/check_metrics_endpoint.py`` scrape ``/v1/metrics`` and fail on
+malformed output instead of trusting the renderer.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+# Seconds-oriented bounds: task phases run 5ms (host stage of a tiny shard)
+# to minutes (a cold-compile execute); queue waits can reach minutes on a
+# backed-up drain. +Inf is implicit (the overflow slot).
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _escape_label(value: Any) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_num(value: float) -> str:
+    """Prometheus sample value: integers render bare, floats via repr."""
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_bound(bound: float) -> str:
+    """``le`` label text: '0.005', '1', '+Inf'."""
+    if bound == float("inf"):
+        return "+Inf"
+    return "%g" % bound
+
+
+class _Metric:
+    """Base: one named family holding labeled series. Series mutation is
+    guarded by the owning registry's lock (no per-metric locks to rank)."""
+
+    kind = "untyped"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Sequence[str],
+        lock: threading.Lock,
+    ) -> None:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid label name {ln!r} on {name!r}")
+        self.name = name
+        self.help = help
+        self.labelnames: Tuple[str, ...] = tuple(labelnames)
+        self._lock = lock
+        self._series: Dict[Tuple[str, ...], Any] = {}
+
+    def _key(self, labels: Mapping[str, Any]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.labelnames)}"
+            )
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        if amount < 0:
+            raise ValueError(f"{self.name}: counters only go up ({amount})")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
+
+    def dec(self, amount: float = 1.0, **labels: Any) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram. Each series stores per-bucket (non-cumulative)
+    counts with a final +Inf overflow slot, plus sum and count — cumulation
+    happens at render time, summation at merge time."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Sequence[str],
+        lock: threading.Lock,
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> None:
+        super().__init__(name, help, labelnames, lock)
+        bounds = tuple(float(b) for b in buckets)
+        if not bounds or list(bounds) != sorted(set(bounds)):
+            raise ValueError(f"{name}: buckets must be sorted and unique")
+        if any(b != b or b == float("inf") for b in bounds):
+            raise ValueError(f"{name}: buckets must be finite (+Inf is implicit)")
+        self.buckets = bounds
+
+    def observe(self, value: float, **labels: Any) -> None:
+        v = float(value)
+        key = self._key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = {
+                    "counts": [0] * (len(self.buckets) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+                self._series[key] = series
+            i = len(self.buckets)  # +Inf slot
+            for j, bound in enumerate(self.buckets):
+                if v <= bound:
+                    i = j
+                    break
+            series["counts"][i] += 1
+            series["sum"] += v
+            series["count"] += 1
+
+
+class MetricsRegistry:
+    """Thread-safe named collection of metrics; get-or-create semantics so
+    independent modules can reference the same family."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], **kwargs: Any) -> Any:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls or existing.labelnames != tuple(
+                    labelnames
+                ):
+                    raise ValueError(
+                        f"metric {name!r} re-registered with a different "
+                        f"type/labels"
+                    )
+                return existing
+            metric = cls(name, help, labelnames, self._lock, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labelnames, buckets=buckets
+        )
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able dump of every series — the lease-push wire format and
+        the input to ``merge_snapshots`` / ``render_snapshots``."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for name, m in self._metrics.items():
+                fam: Dict[str, Any] = {
+                    "type": m.kind,
+                    "help": m.help,
+                    "labels": list(m.labelnames),
+                    "series": [],
+                }
+                if isinstance(m, Histogram):
+                    fam["buckets"] = list(m.buckets)
+                for key, value in m._series.items():
+                    labels = dict(zip(m.labelnames, key))
+                    if isinstance(m, Histogram):
+                        fam["series"].append({
+                            "labels": labels,
+                            "counts": list(value["counts"]),
+                            "sum": value["sum"],
+                            "count": value["count"],
+                        })
+                    else:
+                        fam["series"].append(
+                            {"labels": labels, "value": value}
+                        )
+                out[name] = fam
+        return out
+
+    def render(self) -> str:
+        return render_snapshots([(self.snapshot(), {})])
+
+
+# ---- process-global default (standalone callers; tests inject instances) ----
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default_registry
+
+
+# ---- snapshot algebra ----
+
+def _series_key(labels: Mapping[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def merge_snapshots(snapshots: Iterable[Mapping[str, Any]]) -> Dict[str, Any]:
+    """Sum same-name/same-labels series across snapshots (the fleet merge:
+    one snapshot per agent → fleet totals). Counters, gauges, and histogram
+    buckets all add; families whose type or buckets disagree keep the first
+    definition and skip conflicting series (a half-upgraded fleet must not
+    corrupt the merged view)."""
+    out: Dict[str, Any] = {}
+    for snap in snapshots:
+        if not isinstance(snap, Mapping):
+            continue
+        for name, fam in snap.items():
+            if not isinstance(fam, Mapping) or "series" not in fam:
+                continue
+            dst = out.get(name)
+            if dst is None:
+                dst = {
+                    "type": fam.get("type", "untyped"),
+                    "help": fam.get("help", ""),
+                    "labels": list(fam.get("labels", [])),
+                    "series": [],
+                    "_index": {},
+                }
+                if "buckets" in fam:
+                    dst["buckets"] = list(fam["buckets"])
+                out[name] = dst
+            fam_buckets = list(fam["buckets"]) if "buckets" in fam else None
+            if dst["type"] != fam.get("type") or \
+                    dst.get("buckets") != fam_buckets:
+                continue
+            for s in fam.get("series", []):
+                labels = s.get("labels", {})
+                key = _series_key(labels)
+                have = dst["_index"].get(key)
+                if dst["type"] == "histogram":
+                    if have is None:
+                        have = {
+                            "labels": dict(labels),
+                            "counts": [0] * len(s.get("counts", [])),
+                            "sum": 0.0,
+                            "count": 0,
+                        }
+                        dst["_index"][key] = have
+                        dst["series"].append(have)
+                    counts = s.get("counts", [])
+                    if len(counts) == len(have["counts"]):
+                        have["counts"] = [
+                            a + b for a, b in zip(have["counts"], counts)
+                        ]
+                        have["sum"] += float(s.get("sum", 0.0))
+                        have["count"] += int(s.get("count", 0))
+                else:
+                    if have is None:
+                        have = {"labels": dict(labels), "value": 0.0}
+                        dst["_index"][key] = have
+                        dst["series"].append(have)
+                    have["value"] += float(s.get("value", 0.0))
+    for fam in out.values():
+        fam.pop("_index", None)
+    return out
+
+
+def render_snapshots(
+    parts: Sequence[Tuple[Mapping[str, Any], Mapping[str, str]]]
+) -> str:
+    """Render snapshots into one Prometheus text exposition.
+
+    ``parts`` is ``[(snapshot, extra_labels), ...]`` — extra labels (e.g.
+    ``{"agent": "tpu-vm-3"}``) are stamped onto every series of that
+    snapshot, which is how one exposition can carry the controller's own
+    series next to per-agent or fleet-merged ones without name collisions.
+    One HELP/TYPE header per family regardless of how many parts carry it;
+    a family re-appearing with a different type is skipped (exposition
+    validity beats completeness).
+    """
+    families: Dict[str, Dict[str, Any]] = {}
+    order: List[str] = []
+    for snap, extra in parts:
+        if not isinstance(snap, Mapping):
+            continue
+        for name, fam in snap.items():
+            if not isinstance(fam, Mapping) or not _NAME_RE.match(str(name)):
+                continue
+            entry = families.get(name)
+            if entry is None:
+                entry = {
+                    "type": fam.get("type", "untyped"),
+                    "help": fam.get("help", ""),
+                    "chunks": [],
+                }
+                families[name] = entry
+                order.append(name)
+            elif entry["type"] != fam.get("type"):
+                continue
+            entry["chunks"].append((fam, dict(extra or {})))
+
+    lines: List[str] = []
+    for name in order:
+        entry = families[name]
+        kind = entry["type"]
+        if entry["help"]:
+            lines.append(f"# HELP {name} {_escape_help(entry['help'])}")
+        lines.append(f"# TYPE {name} {kind}")
+        for fam, extra in entry["chunks"]:
+            for s in fam.get("series", []):
+                labels = {**s.get("labels", {}), **extra}
+                if kind == "histogram":
+                    bounds = [float(b) for b in fam.get("buckets", [])]
+                    counts = list(s.get("counts", []))
+                    cum = 0
+                    for bound, c in zip(bounds, counts):
+                        cum += c
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_labels_text({**labels, 'le': _fmt_bound(bound)})}"
+                            f" {cum}"
+                        )
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_labels_text({**labels, 'le': '+Inf'})}"
+                        f" {int(s.get('count', 0))}"
+                    )
+                    lines.append(
+                        f"{name}_sum{_labels_text(labels)}"
+                        f" {_fmt_num(s.get('sum', 0.0))}"
+                    )
+                    lines.append(
+                        f"{name}_count{_labels_text(labels)}"
+                        f" {int(s.get('count', 0))}"
+                    )
+                else:
+                    lines.append(
+                        f"{name}{_labels_text(labels)}"
+                        f" {_fmt_num(s.get('value', 0.0))}"
+                    )
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _labels_text(labels: Mapping[str, Any]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def histogram_quantile(
+    buckets: Sequence[float], counts: Sequence[int], q: float
+) -> Optional[float]:
+    """Estimate the q-quantile (0..1) from per-bucket counts (+Inf slot
+    last), linearly interpolating within the landing bucket — the same
+    estimate Prometheus's ``histogram_quantile`` makes. None when empty.
+    Values in the +Inf slot clamp to the largest finite bound."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            if i >= len(buckets):  # +Inf slot
+                return float(buckets[-1]) if buckets else None
+            lower = float(buckets[i - 1]) if i > 0 else 0.0
+            upper = float(buckets[i])
+            if c <= 0:
+                return upper
+            frac = (target - (cum - c)) / c
+            return lower + (upper - lower) * frac
+    return float(buckets[-1]) if buckets else None
+
+
+# ---- exposition parsing / validation (the scrape side) ----
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"        # metric name
+    r"(?:\{(.*)\})?"                        # optional label block
+    r"\s+"
+    r"([^\s]+)"                             # value
+    r"(?:\s+[0-9]+)?$"                      # optional timestamp
+)
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"'
+)
+
+
+def _unescape_label(value: str) -> str:
+    return (
+        value.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    )
+
+
+def parse_exposition(
+    text: str,
+) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """Prometheus text → ``{sample_name: [(labels, value), ...]}``.
+
+    Histogram component samples keep their suffixed names
+    (``x_bucket``/``x_sum``/``x_count``). Malformed lines raise ValueError —
+    scraping callers that prefer tolerance should run
+    ``validate_exposition`` first.
+    """
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: malformed sample {line!r}")
+        name, labelblock, raw = m.group(1), m.group(2), m.group(3)
+        labels: Dict[str, str] = {}
+        if labelblock:
+            consumed = 0
+            for pm in _LABEL_PAIR_RE.finditer(labelblock):
+                labels[pm.group(1)] = _unescape_label(pm.group(2))
+                consumed += 1
+            # every comma-separated pair must have parsed
+            expect = [p for p in re.split(r",(?=[a-zA-Z_])", labelblock) if p]
+            if consumed != len(expect):
+                raise ValueError(
+                    f"line {lineno}: malformed labels {labelblock!r}"
+                )
+        try:
+            value = float(raw)
+        except ValueError as exc:
+            raise ValueError(
+                f"line {lineno}: non-numeric value {raw!r}"
+            ) from exc
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def validate_exposition(
+    text: str, required: Iterable[str] = ()
+) -> List[str]:
+    """Structural check of one exposition; returns problems (empty = valid).
+
+    Catches: malformed sample/comment lines, samples whose family carries no
+    ``# TYPE`` declaration, duplicate TYPE declarations, histogram families
+    missing their ``_sum``/``_count``/``+Inf`` samples, and missing
+    ``required`` family names. This is the checker
+    ``scripts/check_metrics_endpoint.py`` and the tests share.
+    """
+    problems: List[str] = []
+    types: Dict[str, str] = {}
+    samples: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        stripped = line.strip()
+        if not stripped:
+            continue
+        if stripped.startswith("#"):
+            parts = stripped.split(None, 3)
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) < 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"
+                ):
+                    problems.append(f"line {lineno}: malformed TYPE line")
+                elif parts[2] in types:
+                    problems.append(
+                        f"line {lineno}: duplicate TYPE for {parts[2]}"
+                    )
+                else:
+                    types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(stripped)
+        if m is None:
+            problems.append(f"line {lineno}: malformed sample {stripped!r}")
+            continue
+        name, labelblock, raw = m.group(1), m.group(2), m.group(3)
+        try:
+            float(raw)
+        except ValueError:
+            problems.append(f"line {lineno}: non-numeric value {raw!r}")
+            continue
+        labels: Dict[str, str] = {}
+        if labelblock:
+            for pm in _LABEL_PAIR_RE.finditer(labelblock):
+                labels[pm.group(1)] = _unescape_label(pm.group(2))
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                family = base
+                break
+        if family not in types:
+            problems.append(
+                f"line {lineno}: sample {name} has no TYPE declaration"
+            )
+        samples.setdefault(name, []).append((labels, float(raw)))
+    for fam, kind in types.items():
+        if kind != "histogram":
+            continue
+        if not any(
+            f"{fam}{sfx}" in samples for sfx in ("_bucket", "_sum", "_count")
+        ):
+            continue  # declared but unobserved family — legal exposition
+        if f"{fam}_sum" not in samples or f"{fam}_count" not in samples:
+            problems.append(f"histogram {fam} missing _sum/_count samples")
+        if not any(
+            lbl.get("le") == "+Inf" for lbl, _ in samples.get(f"{fam}_bucket", [])
+        ):
+            problems.append(f"histogram {fam} missing +Inf bucket")
+    for name in required:
+        present = name in types or name in samples or any(
+            s.startswith(name + "_") for s in samples
+        )
+        if not present:
+            problems.append(f"required series {name} absent")
+    return problems

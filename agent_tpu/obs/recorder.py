@@ -1,0 +1,131 @@
+"""Flight recorder — a bounded ring buffer of recent structured events.
+
+The diagnosable-after-the-fact channel (ISSUE 2 tentpole 4): the agent and
+the controller each keep the last ``capacity`` events (leases, phase
+transitions, epoch fences, errors) in memory — O(capacity), NOT O(tasks),
+so a 10M-row drain costs the same RAM as a 10-row one — and dump them as
+JSONL:
+
+- on demand: ``SIGUSR1`` in the agent (``install_sigusr1_dump``),
+  ``GET /v1/debug/events`` on the controller;
+- on fatal errors: the agent's ``main()`` dumps before re-raising, so a
+  wedged or crashed drain leaves its last moves on disk without re-running
+  it under extra logging.
+
+Events carry the task trace fields (``job_id``, ``lease_id``, ``attempt``)
+stamped at lease time, so one job's life greps across the controller
+journal, agent logs, and both recorders.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+DEFAULT_CAPACITY = 2048
+
+
+class FlightRecorder:
+    """Thread-safe bounded event ring. ``record`` is called on hot paths —
+    it must never raise and never grow beyond ``capacity``."""
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        clock=time.time,
+    ) -> None:
+        self.capacity = max(1, int(capacity))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._events: "collections.deque" = collections.deque(
+            maxlen=self.capacity
+        )
+        self._dropped = 0  # events pushed out of the ring
+
+    def record(self, kind: str, **fields: Any) -> None:
+        event = {"ts": self._clock(), "kind": kind}
+        event.update(fields)
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self._dropped += 1
+            self._events.append(event)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def dump(self, path: str) -> int:
+        """Write the ring as JSONL (oldest first); returns events written.
+        Non-JSON field values stringify (``default=str``) — a dump must
+        never fail on an exotic payload."""
+        events = self.events()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for ev in events:
+                f.write(json.dumps(ev, default=str) + "\n")
+        os.replace(tmp, path)
+        return len(events)
+
+
+# ---- process-global default (injectable instances preferred in tests) ----
+
+_default_recorder = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _default_recorder
+
+
+def default_dump_path(tag: str) -> str:
+    """Where on-demand/fatal dumps land: ``$FLIGHT_RECORDER_DIR`` or the
+    system temp dir, one file per tag+pid (restarts never clobber a prior
+    incarnation's post-mortem)."""
+    base = os.environ.get("FLIGHT_RECORDER_DIR") or tempfile.gettempdir()
+    safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in tag)
+    return os.path.join(base, f"agent_tpu_flight_{safe}_{os.getpid()}.jsonl")
+
+
+def install_sigusr1_dump(
+    recorder: FlightRecorder, path: str
+) -> Optional[str]:
+    """Arm ``SIGUSR1`` → dump ``recorder`` to ``path``. Returns the path, or
+    None where unsupported (non-main thread, platforms without SIGUSR1) —
+    callers treat that as a soft degrade, not an error."""
+    import signal
+
+    if not hasattr(signal, "SIGUSR1"):
+        return None
+
+    def _dump(*_args: Any) -> None:
+        try:
+            n = recorder.dump(path)
+            print(
+                f"[agent-tpu] flight recorder dumped {n} events to {path}",
+                flush=True,
+            )
+        except OSError:
+            pass  # a failing dump must not kill the drain
+
+    try:
+        signal.signal(signal.SIGUSR1, _dump)
+    except ValueError:  # not the main thread
+        return None
+    return path

@@ -1,0 +1,56 @@
+"""Scrape-side helpers: read ``GET /v1/metrics`` back into numbers.
+
+``bench.py`` and ``scripts/drain_at_scale.py`` attribute drain time per op
+by scraping the controller's exposition instead of re-deriving spans from
+result bodies (``utils/spans.py`` stays as the fallback when scraping is
+unavailable — e.g. a controller predating the endpoint). Stdlib-only, like
+the rest of ``agent_tpu.obs``.
+"""
+
+from __future__ import annotations
+
+import urllib.request
+from typing import Dict, Iterable, Optional
+
+from agent_tpu.obs.metrics import parse_exposition
+
+
+def fetch_metrics_text(
+    base_url: str, timeout: float = 10.0
+) -> Optional[str]:
+    """GET ``<base_url>/v1/metrics`` → exposition text, or None on any
+    failure (callers fall back to result-body spans)."""
+    url = base_url.rstrip("/") + "/v1/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            if resp.status != 200:
+                return None
+            return resp.read().decode("utf-8", errors="replace")
+    except Exception:  # noqa: BLE001 — scrape is best-effort by contract
+        return None
+
+
+def op_phase_seconds(
+    text: str,
+    ops: Iterable[str],
+    phases: Iterable[str] = ("execute", "fetch"),
+) -> Dict[str, float]:
+    """Sum ``task_phase_seconds_sum{op,phase}`` over ``phases`` per op —
+    the scraped equivalent of ``utils.spans.op_span_ms`` (which sums
+    ``device_ms + fetch_ms``; the execute phase is the device-dispatch
+    span). Series carrying an ``agent`` label and the fleet-merged ones
+    would double-count if both were summed; only unlabeled (fleet/merged)
+    series count."""
+    phases = set(phases)
+    out = {op: 0.0 for op in ops}
+    try:
+        samples = parse_exposition(text)
+    except ValueError:
+        return out
+    for labels, value in samples.get("task_phase_seconds_sum", []):
+        if "agent" in labels:
+            continue
+        op = labels.get("op")
+        if op in out and labels.get("phase") in phases:
+            out[op] += value
+    return out

@@ -524,6 +524,10 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
 
     out: Dict[str, Any] = {
         "ok": True,
+        # Explicit op attribution (ISSUE 2 satellite): the reference shape
+        # carried no "op" key, forcing utils/spans.result_op to guess from
+        # "summaries" — the heuristic survives only for old bodies.
+        "op": "map_summarize",
         "device": state["device"],
         "model": state["model_id"],
         "num_beams": state["num_beams"],

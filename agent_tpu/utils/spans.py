@@ -15,9 +15,12 @@ from typing import Dict, Iterable, Mapping
 
 
 def result_op(result: Mapping) -> str | None:
-    """The op a result body belongs to. Summarize results carry no "op" key
-    (the reference shape {ok, summary, device, model}) — detect them by
-    their summaries/sink payload."""
+    """The op a result body belongs to. Every op now stamps ``"op"`` into
+    its result (ISSUE 2 satellite); the summaries/sink sniffing below is
+    kept ONLY as a fallback for old bodies (pre-stamp journals, agents a
+    version behind) and must not grow new cases — new attribution should
+    come from the explicit key or from scraping ``/v1/metrics``
+    (``agent_tpu.obs.scrape``)."""
     op = result.get("op")
     if op:
         return op

@@ -907,6 +907,18 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
             # Leg 2: mixed classify+summarize, one drain. Snapshot the result
             # keys first: Controller.results() is cumulative across legs, and
             # the busy accounting below must cover ONLY this leg's shards.
+            # Same for the scraped metrics: counters are cumulative, so the
+            # per-leg attribution is the scrape DELTA across the leg.
+            from agent_tpu.obs.scrape import (
+                fetch_metrics_text,
+                op_phase_seconds,
+            )
+
+            drain_ops = ("map_classify_tpu", "map_summarize")
+            pre = fetch_metrics_text(server.url)
+            span_pre = (
+                op_phase_seconds(pre, drain_ops) if pre is not None else None
+            )
             seen_jobs = set(controller.results())
             controller.submit_csv_job(
                 path, total_rows=n_rows, shard_size=shard_size,
@@ -919,24 +931,38 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
             )
             wall = _drain_until_done(agent, controller)
             check_all_ok(controller)
-            # Per-op spans (dispatch + deferred fetch) — single definition
-            # in agent_tpu.utils.spans, shared with drain_at_scale.py.
-            from agent_tpu.utils.spans import op_span_ms
+            # Per-op spans (dispatch + deferred fetch): primary source is
+            # the scraped /v1/metrics fleet series (execute+fetch phase
+            # sums, delta across the leg); utils.spans result-body summing
+            # is the fallback when scraping is unavailable.
+            post = fetch_metrics_text(server.url)
+            span_s: dict = {}
+            span_source = "scrape"
+            if span_pre is not None and post is not None:
+                span_post = op_phase_seconds(post, drain_ops)
+                span_s = {
+                    op: span_post[op] - span_pre[op] for op in drain_ops
+                }
+            if not any(span_s.values()):
+                from agent_tpu.utils.spans import op_span_ms
 
-            span_ms = op_span_ms(
-                (
-                    r for job_id, r in controller.results().items()
-                    if job_id not in seen_jobs
-                ),
-                ("map_classify_tpu", "map_summarize"),
-            )
+                span_source = "result_bodies"
+                span_ms = op_span_ms(
+                    (
+                        r for job_id, r in controller.results().items()
+                        if job_id not in seen_jobs
+                    ),
+                    drain_ops,
+                )
+                span_s = {op: span_ms[op] / 1e3 for op in drain_ops}
             total_rows = n_rows + DRAIN_SUMMARIZE_ROWS
             mixed_leg = {
                 "rows_per_sec": round(total_rows / wall, 1),
                 "classify_rows": n_rows,
                 "summarize_rows": DRAIN_SUMMARIZE_ROWS,
-                "classify_span_s": round(span_ms["map_classify_tpu"] / 1e3, 2),
-                "summarize_span_s": round(span_ms["map_summarize"] / 1e3, 2),
+                "classify_span_s": round(span_s["map_classify_tpu"], 2),
+                "summarize_span_s": round(span_s["map_summarize"], 2),
+                "span_source": span_source,
                 "wall_s": round(wall, 2),
                 "pipelined": True,
             }

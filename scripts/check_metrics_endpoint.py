@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""CI smoke check for the observability surface (ISSUE 2 satellite).
+
+Boots a real ``ControllerServer``, drains a tiny job through the real
+``Agent`` loop over HTTP (a stdlib urllib shim stands in for requests so
+this needs nothing beyond the repo), then:
+
+- scrapes ``GET /v1/metrics`` and validates the Prometheus text exposition
+  structurally (``agent_tpu.obs.validate_exposition``: malformed lines,
+  missing TYPE declarations, incomplete histograms) plus the presence of the
+  core series every dashboard will key on;
+- pins the extended ``GET /v1/status`` fields;
+- confirms ``GET /v1/debug/events`` serves trace-correlated flight-recorder
+  events.
+
+Exit 0 = clean; 1 = problems (listed one per line). Style sibling of
+``scripts/check_doc_claims.py``: repo-rooted, zero external deps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REQUIRED_SERIES = (
+    # controller-side
+    "controller_lease_requests_total",
+    "controller_tasks_leased_total",
+    "controller_results_total",
+    "controller_queue_wait_seconds",
+    "controller_queue_depth",
+    # fleet-merged agent-side
+    "tasks_total",
+    "lease_requests_total",
+    # synthetic liveness
+    "agent_last_seen_seconds",
+)
+
+REQUIRED_STATUS_KEYS = (
+    "counts", "counts_by_op", "queue_depth", "drained", "stale_results",
+    "agents", "summary", "last_metrics",
+)
+
+
+class _UrllibSession:
+    """The minimal ``requests.Session`` surface Agent needs, on stdlib."""
+
+    def post(self, url, json=None, timeout=10.0):  # noqa: A002 — shim API
+        import json as _json
+
+        data = _json.dumps(json or {}).encode()
+        req = urllib.request.Request(
+            url, data=data, headers={"Content-Type": "application/json"}
+        )
+        try:
+            resp = urllib.request.urlopen(req, timeout=timeout)
+            body = resp.read()
+            status = resp.status
+        except urllib.error.HTTPError as exc:
+            body = exc.read()
+            status = exc.code
+
+        class _Resp:
+            status_code = status
+            text = body.decode("utf-8", errors="replace")
+
+            def json(self):
+                return _json.loads(body)
+
+        return _Resp()
+
+
+def main() -> int:
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.config import AgentConfig, Config
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+    from agent_tpu.obs.metrics import validate_exposition
+
+    problems = []
+    controller = Controller()
+    with ControllerServer(controller) as server:
+        for i in range(3):
+            controller.submit("echo", {"i": i})
+        cfg = Config(agent=AgentConfig(
+            controller_url=server.url, agent_name="ci-smoke",
+            tasks=("echo",), max_tasks=4, idle_sleep_sec=0.0,
+        ))
+        agent = Agent(config=cfg, session=_UrllibSession())
+        agent._profile = {"tier": "ci"}
+        agent.run(max_steps=5)  # serial loop; flushes metrics at the end
+        if not controller.drained():
+            problems.append("tiny drain did not complete in 5 steps")
+
+        with urllib.request.urlopen(server.url + "/v1/metrics") as r:
+            ctype = r.headers.get("Content-Type", "")
+            text = r.read().decode()
+        if "text/plain" not in ctype:
+            problems.append(f"/v1/metrics content-type {ctype!r}")
+        problems += validate_exposition(text, required=REQUIRED_SERIES)
+        if 'tasks_total{op="echo",status="succeeded"} 3' not in text:
+            problems.append(
+                "fleet-merged agent series missing/incorrect: expected "
+                'tasks_total{op="echo",status="succeeded"} 3'
+            )
+
+        with urllib.request.urlopen(server.url + "/v1/status") as r:
+            status = json.load(r)
+        for key in REQUIRED_STATUS_KEYS:
+            if key not in status:
+                problems.append(f"/v1/status missing key {key!r}")
+        if status.get("counts_by_op", {}).get("echo", {}).get("succeeded") != 3:
+            problems.append("/v1/status counts_by_op.echo.succeeded != 3")
+
+        with urllib.request.urlopen(server.url + "/v1/debug/events") as r:
+            events = json.load(r).get("events", [])
+        kinds = {e.get("kind") for e in events}
+        if not {"submit", "lease", "result"} <= kinds:
+            problems.append(
+                f"/v1/debug/events missing core kinds (got {sorted(kinds)})"
+            )
+
+    if problems:
+        for p in problems:
+            print(p)
+        print(f"FAILED: {len(problems)} problem(s)")
+        return 1
+    print("metrics endpoint smoke check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

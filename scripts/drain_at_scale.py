@@ -171,6 +171,17 @@ def main() -> int:
               f"{n_warm} shards, both buckets x both ops)", flush=True)
         agent.running = True
         warm_jobs = set(warm_results)
+        # Per-op attribution now scrapes /v1/metrics (fleet task_phase
+        # series); the warm shards already counted, so the timed numbers
+        # are the scrape delta across the timed window.
+        from agent_tpu.obs.scrape import fetch_metrics_text, op_phase_seconds
+
+        drain_ops = ("map_classify_tpu", "map_summarize")
+        pre_text = fetch_metrics_text(server.url)
+        span_pre = (
+            op_phase_seconds(pre_text, drain_ops)
+            if pre_text is not None else None
+        )
         t_start = time.perf_counter()  # the timed window starts POST-warmup
 
         controller.submit_csv_job(
@@ -251,11 +262,21 @@ def main() -> int:
             op = result_op(r)
             if op in rows_written:
                 rows_written[op] += int(r.get("rows_written", 0))
-        # Per-shard device-side span = dispatch + deferred fetch; single
-        # definition shared with bench.py (agent_tpu.utils.spans).
-        busy_ms = op_span_ms(
-            ok_results, ("map_classify_tpu", "map_summarize")
-        )
+        # Per-shard device-side span = dispatch + deferred fetch. Primary
+        # source: scraped /v1/metrics fleet series (execute+fetch sums,
+        # delta vs the post-warmup scrape); fallback: result-body summing
+        # (agent_tpu.utils.spans, shared with bench.py) when scraping is
+        # unavailable.
+        post_text = fetch_metrics_text(server.url)
+        busy_s = {}
+        span_source = "scrape"
+        if span_pre is not None and post_text is not None:
+            span_post = op_phase_seconds(post_text, drain_ops)
+            busy_s = {op: span_post[op] - span_pre[op] for op in drain_ops}
+        if not any(busy_s.values()):
+            span_source = "result_bodies"
+            busy_ms = op_span_ms(ok_results, drain_ops)
+            busy_s = {op: busy_ms[op] / 1e3 for op in drain_ops}
 
     report = {
         "rows": args.rows,
@@ -278,23 +299,24 @@ def main() -> int:
         # busy time; wall_s / total_rows_per_sec are the primary metrics.
         # (Renamed from the pre-deferred-fetch "device_busy_s" so old
         # reports aren't compared against a different quantity.)
+        "span_source": span_source,
         "classify": {
             "shard_size": CLASSIFY_SHARD,
             "rows_written": rows_written["map_classify_tpu"],
-            "device_span_s": round(busy_ms["map_classify_tpu"] / 1e3, 1),
+            "device_span_s": round(busy_s["map_classify_tpu"], 1),
             "rows_per_span_sec": round(
-                args.rows / (busy_ms["map_classify_tpu"] / 1e3), 1
-            ) if busy_ms["map_classify_tpu"] else None,
+                args.rows / busy_s["map_classify_tpu"], 1
+            ) if busy_s["map_classify_tpu"] else None,
         },
         "summarize": {
             "shard_size": SUMMARIZE_SHARD,
             "max_new_tokens": SUMMARIZE_MAX_NEW,
             "quant": args.summarize_quant,
             "rows_written": rows_written["map_summarize"],
-            "device_span_s": round(busy_ms["map_summarize"] / 1e3, 1),
+            "device_span_s": round(busy_s["map_summarize"], 1),
             "rows_per_span_sec": round(
-                args.rows / (busy_ms["map_summarize"] / 1e3), 1
-            ) if busy_ms["map_summarize"] else None,
+                args.rows / busy_s["map_summarize"], 1
+            ) if busy_s["map_summarize"] else None,
         },
         "platform": runtime.platform,
         "n_chips": runtime.n_devices,

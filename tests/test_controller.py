@@ -330,6 +330,75 @@ class TestCollectPartials:
                                   "max": 1.0}]})["ok"] is False
 
 
+def test_status_endpoint_schema():
+    """/v1/status schema pinned (ISSUE 2 satellite): stale_results, per-op
+    state counts, queue depth, agents, and the structured summary ride next
+    to the legacy counts/drained/last_metrics fields."""
+    import json
+    import urllib.request
+
+    from agent_tpu.controller.server import ControllerServer
+
+    c = Controller()
+    with ControllerServer(c) as srv:
+        c.submit("echo", {})
+        c.submit("echo", {})
+        c.submit("map_tokenize", {"text": "hi"})
+        lease = c.lease("a1", {"ops": ["echo"]}, max_tasks=1,
+                        metrics={"cpu_util": 0.1})
+        t = lease["tasks"][0]
+        # one stale post (counted), then the real one
+        c.report(lease["lease_id"], t["id"], t["job_epoch"] + 7,
+                 "succeeded", {})
+        c.report(lease["lease_id"], t["id"], t["job_epoch"], "succeeded", {})
+
+        with urllib.request.urlopen(srv.url + "/v1/status") as r:
+            body = json.loads(r.read())
+
+    assert set(body) == {
+        "counts", "counts_by_op", "queue_depth", "drained", "stale_results",
+        "agents", "summary", "last_metrics",
+    }
+    assert body["counts"] == {"succeeded": 1, "pending": 2}
+    assert body["counts_by_op"] == {
+        "echo": {"succeeded": 1, "pending": 1},
+        "map_tokenize": {"pending": 1},
+    }
+    assert body["queue_depth"] == 2
+    assert body["stale_results"] == 1
+    assert body["drained"] is False
+    assert body["agents"]["a1"]["metrics"] == {"cpu_util": 0.1}
+    assert body["agents"]["a1"]["last_seen_sec_ago"] >= 0
+    assert body["summary"]["ops"]["echo"]["succeeded"] == 1
+    assert "uptime_sec" in body["summary"]
+
+
+def test_lease_attempt_rides_task_dict():
+    """to_task carries the attempt counter — the trace field agents stamp
+    into ctx.tags and result bodies."""
+    c = Controller()
+    jid = c.submit("echo", {})
+    lease = c.lease("a1", {"ops": ["echo"]})
+    assert lease["tasks"][0]["attempt"] == 1
+    c.report(lease["lease_id"], jid, lease["tasks"][0]["job_epoch"],
+             "failed", error={"type": "X"})
+    lease2 = c.lease("a1", {"ops": ["echo"]})
+    assert lease2["tasks"][0]["attempt"] == 2
+
+
+def test_metrics_only_poll_leases_nothing():
+    """max_tasks=0 records agent telemetry without handing out work — the
+    drain-end flush channel."""
+    c = Controller()
+    c.submit("echo", {})
+    assert c.lease("a1", {"ops": ["echo"]}, max_tasks=0,
+                   metrics={"ram_mb": 1}) is None
+    assert c.counts() == {"pending": 1}  # nothing leased
+    assert c.agent_metrics["a1"]["metrics"] == {"ram_mb": 1}
+    lease = c.lease("a1", {"ops": ["echo"]})  # a real poll still works
+    assert lease is not None and len(lease["tasks"]) == 1
+
+
 def test_http_job_result_retrieval():
     """Operators submit over HTTP — they must be able to fetch results the
     same way (GET /v1/jobs/<id>)."""

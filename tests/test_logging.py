@@ -1,0 +1,87 @@
+"""Direct unit tests for utils/logging.py (ISSUE 2 satellite) — previously
+only exercised incidentally through agent/controller flows: ``log`` field
+rendering (including non-JSON-serializable values), and ``RateLimiter``
+window behavior under a fake clock."""
+
+import json
+
+from agent_tpu.utils.logging import PREFIX, RateLimiter, log
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestLog:
+    def test_plain_message(self, capsys):
+        log("agent up")
+        out = capsys.readouterr().out
+        assert out == f"{PREFIX} agent up\n"
+
+    def test_fields_render_as_sorted_compact_json(self, capsys):
+        log("task done", op="echo", n=3)
+        out = capsys.readouterr().out.strip()
+        prefix = f"{PREFIX} task done "
+        assert out.startswith(prefix)
+        assert json.loads(out[len(prefix):]) == {"op": "echo", "n": 3}
+        # sort_keys: deterministic line for greps
+        assert out.index('"n"') < out.index('"op"')
+
+    def test_non_json_serializable_fields_stringify(self, capsys):
+        log("weird", value={1, 2})  # sets are not JSON — default=str applies
+        out = capsys.readouterr().out
+        tail = json.loads(out.strip()[len(f"{PREFIX} weird "):])
+        assert tail["value"] in ("{1, 2}", "{2, 1}")
+
+    def test_fields_unstringifiable_fall_back_to_repr(self, capsys):
+        class Cursed:
+            def __str__(self):
+                raise TypeError("no str for you")
+
+            def __repr__(self):
+                return "<cursed>"
+
+        log("worse", value=Cursed())
+        out = capsys.readouterr().out
+        # json.dumps(default=str) raised → repr(fields) fallback, line still
+        # prints (logging must never throw on hot paths).
+        assert out.startswith(f"{PREFIX} worse ")
+        assert "<cursed>" in out
+
+
+class TestRateLimiter:
+    def test_window_gates_per_key(self):
+        clock = FakeClock()
+        rl = RateLimiter(every_sec=10.0, clock=clock)
+        assert rl.ready("lease") is True
+        assert rl.ready("lease") is False     # inside the window
+        assert rl.ready("result") is True     # other keys independent
+        clock.t = 9.999
+        assert rl.ready("lease") is False
+        clock.t = 10.0
+        assert rl.ready("lease") is True      # window elapsed exactly
+        clock.t = 10.5
+        assert rl.ready("lease") is False     # window restarted at 10.0
+
+    def test_log_returns_whether_it_logged(self, capsys):
+        clock = FakeClock()
+        rl = RateLimiter(every_sec=5.0, clock=clock)
+        assert rl.log("exec", "op raised", op="echo") is True
+        assert rl.log("exec", "op raised", op="echo") is False
+        out = capsys.readouterr().out
+        assert out.count("exec: op raised") == 1
+        clock.t = 5.0
+        assert rl.log("exec", "op raised", op="echo") is True
+
+    def test_suppressed_attempt_does_not_reset_window(self):
+        clock = FakeClock()
+        rl = RateLimiter(every_sec=10.0, clock=clock)
+        assert rl.ready("k")
+        clock.t = 6.0
+        assert not rl.ready("k")  # suppressed — must NOT push the window out
+        clock.t = 10.0
+        assert rl.ready("k")      # measured from the last LOGGED event

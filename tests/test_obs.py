@@ -1,0 +1,414 @@
+"""Observability subsystem (ISSUE 2): metrics registry semantics, Prometheus
+exposition validity, fleet merge, flight recorder bounds and dumps, trace
+propagation, and the end-to-end acceptance paths — a pipelined drain showing
+phase/queue/idle series on ``GET /v1/metrics`` and an injected
+``stale_epoch`` fault incrementing the epoch-fence counter."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import pytest
+import requests
+
+from agent_tpu.agent.app import Agent
+from agent_tpu.config import AgentConfig, Config, DeviceConfig
+from agent_tpu.controller.core import Controller
+from agent_tpu.controller.server import ControllerServer
+from agent_tpu.obs.metrics import (
+    MetricsRegistry,
+    histogram_quantile,
+    merge_snapshots,
+    parse_exposition,
+    render_snapshots,
+    validate_exposition,
+)
+from agent_tpu.obs.recorder import FlightRecorder
+from agent_tpu.runtime.runtime import TpuRuntime
+
+TINY = {
+    "d_model": 32, "n_heads": 4, "n_layers": 1, "d_ff": 64,
+    "max_len": 64, "dtype": "float32", "n_classes": 16,
+}
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    return TpuRuntime(
+        config=DeviceConfig(tpu_disabled=True, mesh_shape={"dp": 8}),
+        devices=jax.devices("cpu"),
+    )
+
+
+# ---- registry unit behavior ----
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        r = MetricsRegistry()
+        c = r.counter("tasks_total", "t", ("op", "status"))
+        c.inc(op="echo", status="succeeded")
+        c.inc(2, op="echo", status="succeeded")
+        assert c.value(op="echo", status="succeeded") == 3
+        with pytest.raises(ValueError):
+            c.inc(-1, op="echo", status="succeeded")  # counters go up
+        with pytest.raises(ValueError):
+            c.inc(op="echo")  # label mismatch
+        g = r.gauge("queue_depth", "q", ("queue",))
+        g.set(4, queue="staged")
+        g.dec(queue="staged")
+        assert g.value(queue="staged") == 3
+        h = r.histogram("lat", "l", ("op",), buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v, op="x")
+        snap = r.snapshot()["lat"]["series"][0]
+        assert snap["counts"] == [1, 1, 1]  # le=0.1, le=1, +Inf overflow
+        assert snap["count"] == 3 and snap["sum"] == pytest.approx(5.55)
+
+    def test_reregistration_must_match(self):
+        r = MetricsRegistry()
+        first = r.counter("x_total", "x", ("a",))
+        # get-or-create: same name+type+labels returns the same object
+        assert r.counter("x_total", "ignored help", ("a",)) is first
+        with pytest.raises(ValueError):
+            r.gauge("x_total", "x", ("a",))  # same name, different type
+        with pytest.raises(ValueError):
+            r.counter("x_total", "x", ("b",))  # different labels
+
+    def test_render_is_valid_exposition_with_escaping(self):
+        r = MetricsRegistry()
+        c = r.counter("weird_total", 'help with \\ and\nnewline', ("who",))
+        c.inc(who='quo"te\nand\\slash')
+        text = r.render()
+        assert validate_exposition(text) == []
+        parsed = parse_exposition(text)
+        (labels, value), = parsed["weird_total"]
+        assert labels["who"] == 'quo"te\nand\\slash' and value == 1
+
+    def test_merge_and_fleet_render(self):
+        def make(n):
+            r = MetricsRegistry()
+            r.counter("tasks_total", "t", ("op",)).inc(n, op="echo")
+            h = r.histogram("task_phase_seconds", "p", ("phase",))
+            h.observe(0.01 * n, phase="stage")
+            r.gauge("queue_depth", "q", ("queue",)).set(n, queue="staged")
+            return r.snapshot()
+
+        fleet = merge_snapshots([make(1), make(2)])
+        (s,) = fleet["tasks_total"]["series"]
+        assert s["value"] == 3
+        (h,) = fleet["task_phase_seconds"]["series"]
+        assert h["count"] == 2 and h["sum"] == pytest.approx(0.03)
+        (g,) = fleet["queue_depth"]["series"]
+        assert g["value"] == 3  # gauges sum across the fleet
+        text = render_snapshots([(fleet, {}), (make(1), {"agent": "a1"})])
+        assert validate_exposition(text) == []
+        # per-agent series carry the agent label; fleet ones do not
+        samples = parse_exposition(text)["tasks_total"]
+        assert sorted(lbl.get("agent", "") for lbl, _ in samples) == ["", "a1"]
+
+    def test_histogram_quantile_interpolates(self):
+        buckets = [0.1, 1.0, 10.0]
+        counts = [0, 100, 0, 0]  # all observations in (0.1, 1.0]
+        assert histogram_quantile(buckets, counts, 0.5) == pytest.approx(0.55)
+        assert histogram_quantile(buckets, [0, 0, 0, 0], 0.5) is None
+        # +Inf landings clamp to the largest finite bound
+        assert histogram_quantile(buckets, [0, 0, 0, 5], 0.99) == 10.0
+
+    def test_thread_safety_smoke(self):
+        r = MetricsRegistry()
+        c = r.counter("n_total", "n", ("t",))
+
+        def work():
+            for _ in range(1000):
+                c.inc(t="x")
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value(t="x") == 8000
+
+
+class TestScrapeHelpers:
+    def test_op_phase_seconds_sums_fleet_series_only(self):
+        from agent_tpu.obs.scrape import op_phase_seconds
+
+        r = MetricsRegistry()
+        h = r.histogram("task_phase_seconds", "p", ("op", "phase"))
+        h.observe(2.0, op="map_classify_tpu", phase="execute")
+        h.observe(0.5, op="map_classify_tpu", phase="fetch")
+        h.observe(9.0, op="map_classify_tpu", phase="stage")  # not counted
+        h.observe(1.0, op="map_summarize", phase="execute")
+        snap = r.snapshot()
+        # fleet series unlabeled; a per-agent copy must NOT double-count
+        text = render_snapshots([(snap, {}), (snap, {"agent": "a1"})])
+        spans = op_phase_seconds(
+            text, ("map_classify_tpu", "map_summarize")
+        )
+        assert spans["map_classify_tpu"] == pytest.approx(2.5)
+        assert spans["map_summarize"] == pytest.approx(1.0)
+
+    def test_op_phase_seconds_tolerates_garbage(self):
+        from agent_tpu.obs.scrape import op_phase_seconds
+
+        assert op_phase_seconds("not prometheus {{{", ("x",)) == {"x": 0.0}
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        fr = FlightRecorder(capacity=16)
+        for i in range(10_000):
+            fr.record("ev", i=i)
+        assert len(fr) == 16
+        assert fr.dropped == 10_000 - 16
+        assert [e["i"] for e in fr.events()] == list(range(9984, 10_000))
+
+    def test_dump_jsonl_stringifies_exotic_fields(self, tmp_path):
+        fr = FlightRecorder(capacity=4)
+        fr.record("ev", weird={1, 2}, job_id="j1")
+        path = str(tmp_path / "dump.jsonl")
+        assert fr.dump(path) == 1
+        (line,) = open(path).read().splitlines()
+        assert json.loads(line)["job_id"] == "j1"
+
+
+class TestResultOpAttribution:
+    """Satellite: ops stamp "op"; the spans heuristic survives only as a
+    fallback for old bodies — both paths covered."""
+
+    def test_explicit_op_key_wins(self):
+        from agent_tpu.utils.spans import result_op
+
+        assert result_op({"op": "map_summarize", "summaries": []}) \
+            == "map_summarize"
+        assert result_op({"op": "map_classify_tpu"}) == "map_classify_tpu"
+
+    def test_heuristic_fallback_for_old_bodies(self):
+        from agent_tpu.utils.spans import result_op
+
+        assert result_op({"ok": True, "summaries": ["x"]}) == "map_summarize"
+        assert result_op({"ok": True, "summary": "x"}) == "map_summarize"
+        assert result_op(
+            {"ok": True, "output_path": "/s/map_summarize_rows_0.jsonl"}
+        ) == "map_summarize"
+        assert result_op({"ok": True}) is None
+
+    def test_summarize_result_carries_op(self, runtime):
+        from agent_tpu.ops import get_op
+        from agent_tpu.runtime.context import OpContext
+
+        tiny = {
+            "d_model": 32, "n_heads": 4, "n_enc_layers": 1,
+            "n_dec_layers": 1, "d_ff": 64, "max_src_len": 64,
+            "max_tgt_len": 16, "dtype": "float32",
+        }
+        out = get_op("map_summarize")(
+            {"texts": ["op stamping test row"], "max_length": 4,
+             "model_config": tiny},
+            OpContext(runtime=runtime),
+        )
+        assert out["ok"] is True and out["op"] == "map_summarize"
+
+
+# ---- end-to-end acceptance ----
+
+def _scrape(url):
+    with urllib.request.urlopen(url + "/v1/metrics") as r:
+        text = r.read().decode()
+    assert validate_exposition(text) == []
+    return text, parse_exposition(text)
+
+
+def _sample(parsed, name, **want):
+    """Sum samples of ``name`` whose labels include ``want``."""
+    total, n = 0.0, 0
+    for labels, value in parsed.get(name, []):
+        if all(labels.get(k) == v for k, v in want.items()):
+            total += value
+            n += 1
+    return total if n else None
+
+
+def _drain_pipelined(controller, server, runtime, tasks=("map_classify_tpu",)):
+    cfg = Config(agent=AgentConfig(
+        controller_url=server.url, agent_name="obs-pipe",
+        tasks=tasks, idle_sleep_sec=0.0, pipeline_depth=2,
+    ))
+    agent = Agent(config=cfg, session=requests.Session(), runtime=runtime)
+    agent._profile = {"tier": "test"}
+
+    def watch():
+        deadline = time.time() + 120
+        while not controller.drained() and time.time() < deadline:
+            time.sleep(0.02)
+        agent.shutdown()
+
+    threading.Thread(target=watch, daemon=True).start()
+    agent.run()
+    return agent
+
+
+def test_pipelined_drain_metrics_on_v1_metrics(runtime, tmp_path):
+    """The acceptance path: after a pipelined drain, /v1/metrics serves a
+    valid exposition whose fleet-merged series show all three phases,
+    queue-depth gauges, and device idle time — and the controller-side
+    counters/histograms cover the lease/result flow."""
+    csv = tmp_path / "rows.csv"
+    csv.write_text(
+        "id,text\n" + "".join(f'{i},"obs drain row {i}"\n' for i in range(64)),
+        encoding="utf-8",
+    )
+    c = Controller()
+    c.submit_csv_job(
+        str(csv), total_rows=64, shard_size=16, map_op="map_classify_tpu",
+        extra_payload={"text_field": "text", "allow_fallback": False,
+                       "result_format": "columnar",
+                       "model_config": dict(TINY), "topk": 3},
+    )
+    with ControllerServer(c) as server:
+        _drain_pipelined(c, server, runtime)
+        text, parsed = _scrape(server.url)
+
+    assert c.counts() == {"succeeded": 4}
+    # fleet-merged agent series (no agent label): all three phases nonzero
+    for phase in ("stage", "execute", "finalize"):
+        s = _sample(parsed, "task_phase_seconds_sum",
+                    op="map_classify_tpu", phase=phase)
+        assert s is not None and s > 0, (phase, text)
+        assert _sample(parsed, "task_phase_seconds_count",
+                       op="map_classify_tpu", phase=phase) == 4
+    # queue-depth gauges exist for both pipeline queues
+    assert _sample(parsed, "queue_depth", queue="staged") is not None
+    assert _sample(parsed, "queue_depth", queue="post") is not None
+    # the device thread necessarily idled waiting for the first lease
+    assert _sample(parsed, "device_idle_seconds_total") > 0
+    assert _sample(parsed, "device_busy_seconds_total") > 0
+    assert _sample(parsed, "tasks_total",
+                   op="map_classify_tpu", status="succeeded") == 4
+    # controller side
+    assert _sample(parsed, "controller_tasks_leased_total",
+                   op="map_classify_tpu") == 4
+    assert _sample(parsed, "controller_results_total",
+                   op="map_classify_tpu", outcome="succeeded") == 4
+    assert _sample(parsed, "controller_queue_wait_seconds_count",
+                   op="map_classify_tpu") == 4
+    assert _sample(parsed, "controller_lease_requests_total",
+                   outcome="granted") >= 1
+    assert _sample(parsed, "agent_last_seen_seconds", agent="obs-pipe") \
+        is not None
+
+
+def test_stale_epoch_fault_increments_fence_counter_end_to_end(runtime):
+    """Injected stale_epoch → the agent's result arrives fenced; the
+    rejection is a real counter on /v1/metrics, not just an attribute."""
+    c = Controller(lease_ttl_sec=0.2)
+    c.submit("echo", {"x": 1})
+    c.inject("stale_epoch")
+    with ControllerServer(c) as server:
+        cfg = Config(agent=AgentConfig(
+            controller_url=server.url, agent_name="fence",
+            tasks=("echo",), idle_sleep_sec=0.0, pipeline_depth=0,
+        ))
+        agent = Agent(config=cfg, session=requests.Session())
+        agent._profile = {"tier": "test"}
+        agent.step()  # executes; result is fenced off
+        assert c.stale_results == 1
+        time.sleep(0.25)  # lease TTL passes; job re-queues at bumped epoch
+        deadline = time.time() + 30
+        while not c.drained() and time.time() < deadline:
+            agent.step()
+        assert c.drained()
+        agent.push_metrics()
+        _, parsed = _scrape(server.url)
+    assert _sample(parsed, "controller_results_total",
+                   op="echo", outcome="stale_epoch") == 1
+    assert _sample(parsed, "controller_results_total",
+                   op="echo", outcome="succeeded") == 1
+    assert _sample(parsed, "controller_lease_expirations_total",
+                   op="echo") == 1
+    # the fence event is in the controller's flight recorder, trace-intact
+    kinds = {e["kind"] for e in c.recorder.events()}
+    assert "epoch_fence" in kinds
+
+
+def test_trace_propagates_into_result_bodies(runtime, tmp_path):
+    """trace={job_id, attempt, lease_id} stamped at lease time reaches the
+    stored result via ctx.tags, serial and pipelined alike."""
+    c = Controller()
+    jid = c.submit("map_classify_tpu",
+                   {"texts": ["trace row"], "topk": 2,
+                    "model_config": dict(TINY), "allow_fallback": False})
+    with ControllerServer(c) as server:
+        _drain_pipelined(c, server, runtime)
+    result = c.job_snapshot(jid)["result"]
+    trace = result["trace"]
+    assert trace["job_id"] == jid
+    assert trace["attempt"] == 1
+    assert isinstance(trace["lease_id"], str) and trace["lease_id"]
+    # and the controller's recorder kept lease/result events for the job
+    evs = [e for e in c.recorder.events() if e.get("job_id") == jid]
+    assert {"submit", "lease", "result"} <= {e["kind"] for e in evs}
+    assert any(e.get("lease_id") == trace["lease_id"] for e in evs)
+
+
+def test_flight_recorder_dumps_correlate_across_both_sides(runtime, tmp_path):
+    """Injected failure: a missing shard file hard-fails a job (retry, then
+    stuck failed). Dumps from the agent and controller recorders both carry
+    the job's trace-correlated events."""
+    c = Controller()
+    jid = c.submit("map_classify_tpu",
+                   {"source_uri": str(tmp_path / "missing.csv"),
+                    "start_row": 0, "shard_size": 8})
+    with ControllerServer(c) as server:
+        agent = _drain_pipelined(c, server, runtime)
+    assert c.job_snapshot(jid)["state"] == "failed"
+
+    a_path = str(tmp_path / "agent.jsonl")
+    c_path = str(tmp_path / "controller.jsonl")
+    agent.recorder.dump(a_path)
+    c.recorder.dump(c_path)
+    a_events = [json.loads(ln) for ln in open(a_path)]
+    c_events = [json.loads(ln) for ln in open(c_path)]
+    a_mine = [e for e in a_events if e.get("job_id") == jid]
+    c_mine = [e for e in c_events if e.get("job_id") == jid]
+    # agent side saw the op raise (twice: attempt + retry)
+    errors = [e for e in a_mine if e["kind"] == "error"]
+    assert len(errors) == 2
+    assert errors[0]["type"] in ("FileNotFoundError", "OSError")
+    # controller side saw both lease attempts and the failed results
+    assert sum(1 for e in c_mine if e["kind"] == "lease") == 2
+    assert sum(1 for e in c_mine
+               if e["kind"] == "result" and e["state"] == "failed") == 2
+    # correlation: the same lease_id appears on both sides
+    a_leases = {e.get("lease_id") for e in a_mine if e.get("lease_id")}
+    c_leases = {e.get("lease_id") for e in c_mine if e.get("lease_id")}
+    assert a_leases & c_leases
+
+
+def test_status_summary_exposes_phase_percentiles(runtime, tmp_path):
+    csv = tmp_path / "r.csv"
+    csv.write_text(
+        "id,text\n" + "".join(f'{i},"row {i}"\n' for i in range(32)),
+        encoding="utf-8",
+    )
+    c = Controller()
+    c.submit_csv_job(
+        str(csv), total_rows=32, shard_size=8, map_op="map_classify_tpu",
+        extra_payload={"text_field": "text", "allow_fallback": False,
+                       "result_format": "columnar",
+                       "model_config": dict(TINY), "topk": 3},
+    )
+    with ControllerServer(c) as server:
+        _drain_pipelined(c, server, runtime)
+        with urllib.request.urlopen(server.url + "/v1/status") as r:
+            status = json.load(r)
+    summary = status["summary"]
+    assert summary["ops"]["map_classify_tpu"]["succeeded"] == 4
+    phases = summary["task_phase_seconds"]["map_classify_tpu"]
+    for phase in ("stage", "execute", "finalize"):
+        assert phases[phase]["count"] == 4
+        assert phases[phase]["p50"] is not None
+        assert phases[phase]["p99"] >= phases[phase]["p50"]
