@@ -16,15 +16,22 @@ Behavioral contract kept from the reference:
   forked child would wedge the TPU runtime. Parallelism lives *inside* the op
   (batched SPMD over the mesh), not in host processes.
 - status 0 = transport error (reference ``app.py:146-148``); lease errors back
-  off ``error_backoff_sec`` with per-key rate-limited logging; result-post
-  failures are logged but non-fatal; empty lease sleeps ``idle_sleep_sec``.
+  off with capped exponential backoff + decorrelated jitter (ISSUE 3 —
+  ``error_backoff_sec`` is the base; the reference slept it flat) with
+  per-key rate-limited logging; empty lease sleeps ``idle_sleep_sec`` ±25%
+  jitter so a restarted fleet doesn't poll in lockstep.
 - SIGINT/SIGTERM flip a running flag → graceful drain after the in-flight task.
 - Exit code 2 when TASKS resolves to no ops.
 
 New here: per-task phase timings (lease wait / execute / report) embedded in
-the result for tracing (SURVEY.md §5.1), and device telemetry from
+the result for tracing (SURVEY.md §5.1), device telemetry from
 ``TpuRuntime.describe()`` shipped in the lease ``metrics`` channel alongside
-host cpu/ram (reference ``app.py:74-83``).
+host cpu/ram (reference ``app.py:74-83``), and the **result spool** (ISSUE 3):
+a completed result whose post fails transiently is spooled (bounded ring +
+optional ``RESULT_SPOOL_PATH`` JSONL) and redelivered with backoff on later
+loop iterations instead of dropped — a controller restart inside the lease
+window no longer re-executes finished shards; epoch fencing makes the
+redelivery idempotent.
 """
 
 from __future__ import annotations
@@ -34,12 +41,19 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from agent_tpu.agent.spool import ResultSpool
 from agent_tpu.config import Config
 from agent_tpu.obs.metrics import MetricsRegistry
 from agent_tpu.obs.recorder import FlightRecorder
 from agent_tpu.ops import OpFn, load_ops
 from agent_tpu.utils.errors import structured_error
 from agent_tpu.utils.logging import RateLimiter, log
+from agent_tpu.utils.retry import (
+    PERMANENT,
+    RetryPolicy,
+    classify_http,
+    jittered,
+)
 
 # result-timings key → task_phase_seconds phase label. The ops stamp
 # milliseconds into ctx.tags["timings"] (see map_classify_tpu.finalize);
@@ -124,6 +138,33 @@ class Agent:
         self.m_device_busy = self.obs.counter(
             "device_busy_seconds_total",
             "Device-thread seconds dispatching op execute phases")
+        self.m_post_fail = self.obs.counter(
+            "result_post_failures_total",
+            "Result posts that failed (then spooled, or dropped if the "
+            "failure was permanent)", ("op",))
+        self.m_redeliveries = self.obs.counter(
+            "result_redeliveries_total",
+            "Spooled-result redelivery outcomes (delivered/"
+            "dropped_permanent/dropped_overflow/expired)", ("outcome",))
+        self.m_spool_depth = self.obs.gauge(
+            "result_spool_depth", "Completed results awaiting redelivery")
+        # Fault tolerance (ISSUE 3): undelivered results spool here and
+        # redeliver with decorrelated backoff; lease errors share the same
+        # policy. error_backoff_sec stays the lease-retry base so the legacy
+        # knob keeps meaning what it meant.
+        a = self.config.agent
+        self.spool = ResultSpool(
+            capacity=a.result_spool_max, path=a.result_spool_path or None
+        )
+        self._retry_policy = RetryPolicy(
+            base_sec=a.retry_base_sec, max_sec=a.retry_max_sec
+        )
+        self._lease_retry = RetryPolicy(
+            base_sec=a.error_backoff_sec, max_sec=a.retry_max_sec
+        ).start()
+        self._spool_retry = self._retry_policy.start()
+        self._spool_next_try = 0.0
+        self.m_spool_depth.set(len(self.spool))  # disk-loaded backlog
         # Periodic progress-summary state (the per-task "task done" line is
         # rate-limited away: one line per task floods stdout at drain scale).
         self._progress = {"t": time.monotonic(), "n": 0}
@@ -291,7 +332,13 @@ class Agent:
         result: Any = None,
         error: Any = None,
         session: Any = None,
+        op: str = "?",
     ) -> bool:
+        """Post one result; on transient failure the completed result is
+        SPOOLED for redelivery (never silently dropped — the reference's
+        behavior this replaces, ref ``app.py:307-312``). Permanent failures
+        (the controller rejected the request itself) are counted and dropped:
+        resending identical bytes cannot succeed."""
         http_status, body = self._post_json(
             "/v1/results",
             {
@@ -304,12 +351,89 @@ class Agent:
             },
             session=session,
         )
-        if http_status not in (200, 204):
-            self.rate.log(
-                "result", "post failed", status=http_status, body=str(body)[:200]
-            )
+        if http_status in (200, 204):
+            return True
+        self.m_post_fail.inc(op=op)
+        failure_class = classify_http(http_status)
+        self.recorder.record(
+            "result_post_failed", job_id=job_id, op=op, lease_id=lease_id,
+            status=http_status, **{"class": failure_class},
+        )
+        self.rate.log(
+            "result", "post failed", status=http_status,
+            failure_class=failure_class, body=str(body)[:200],
+        )
+        if failure_class == PERMANENT:
             return False
-        return True
+        evicted = self.spool.put(
+            lease_id, job_id, job_epoch, status,
+            result=result, error=error, op=op,
+        )
+        if evicted is not None:
+            # Ring overflow: the OLDEST spooled result is gone for good —
+            # make the loss visible (pre-spool it was every failed post).
+            self.m_redeliveries.inc(outcome="dropped_overflow")
+            self.recorder.record(
+                "spool_overflow", job_id=evicted.get("job_id"),
+                op=evicted.get("op"),
+            )
+        self.m_spool_depth.set(len(self.spool))
+        return False
+
+    def flush_spool(self, session: Any = None, force: bool = False) -> int:
+        """Redeliver spooled results, oldest first, honoring the backoff
+        window between attempts (``force`` ignores it — drain shutdown).
+        Stops at the first transient failure (the controller is still down);
+        drops entries the controller rejects permanently or that outlived
+        ``retry_deadline_sec``. Epoch fencing makes redelivery of an
+        already-applied result a counted no-op, so this can never
+        double-apply. Returns the number delivered."""
+        if not len(self.spool):
+            return 0
+        now = time.monotonic()
+        if not force and now < self._spool_next_try:
+            return 0
+        deadline = self.config.agent.retry_deadline_sec
+        delivered = 0
+        while len(self.spool):
+            if deadline > 0 and self.spool.age_of_head() >= deadline:
+                entry = self.spool.pop_head()
+                self.m_redeliveries.inc(outcome="expired")
+                self.recorder.record(
+                    "spool_expired", job_id=(entry or {}).get("job_id"),
+                    op=(entry or {}).get("op"),
+                )
+                continue
+            entry = self.spool.head()
+            status, _body = self._post_json(
+                "/v1/results", ResultSpool.wire_body(entry), session=session
+            )
+            if status in (200, 204):
+                self.spool.pop_head()
+                delivered += 1
+                self.m_redeliveries.inc(outcome="delivered")
+                self.recorder.record(
+                    "result_redelivered", job_id=entry.get("job_id"),
+                    op=entry.get("op"),
+                )
+                self._spool_retry.reset()
+                self._spool_next_try = 0.0
+            elif classify_http(status) == PERMANENT:
+                self.spool.pop_head()
+                self.m_redeliveries.inc(outcome="dropped_permanent")
+                self.recorder.record(
+                    "spool_dropped_permanent", job_id=entry.get("job_id"),
+                    op=entry.get("op"), status=status,
+                )
+            else:
+                # Still unreachable: back off before the next redelivery
+                # attempt so a down controller isn't hammered by the loop.
+                self._spool_next_try = (
+                    time.monotonic() + self._spool_retry.next_backoff()
+                )
+                break
+        self.m_spool_depth.set(len(self.spool))
+        return delivered
 
     # ---- task execution ----
 
@@ -416,7 +540,8 @@ class Agent:
                     error_type=resolve_error.get("type"),
                 )
                 self.post_result(
-                    lease_id, job_id, epoch, "failed", error=resolve_error
+                    lease_id, job_id, epoch, "failed", error=resolve_error,
+                    op=op,
                 )
             return
 
@@ -443,7 +568,8 @@ class Agent:
                 # stick the job failed after its one retry), then die in
                 # lockstep with the followers; the slice restarts clean.
                 self.post_result(
-                    lease_id, job_id, epoch, status, result=None, error=error
+                    lease_id, job_id, epoch, status, result=None, error=error,
+                    op=op,
                 )
                 raise
         duration_ms = (time.perf_counter() - t0) * 1000.0
@@ -452,7 +578,9 @@ class Agent:
             if ctx.tags.get("timings"):
                 result.setdefault("timings", ctx.tags["timings"])
             result.setdefault("trace", ctx.tags.get("trace"))
-        self.post_result(lease_id, job_id, epoch, status, result=result, error=error)
+        self.post_result(
+            lease_id, job_id, epoch, status, result=result, error=error, op=op
+        )
         self.tasks_done += 1
         self.m_tasks.inc(op=op, status=status)
         # Serial phases come from the op's own timings (the monolithic call
@@ -470,14 +598,22 @@ class Agent:
     def step(self) -> bool:
         """One loop iteration. Returns True if a task was executed (so callers
         and tests can drive the loop deterministically)."""
+        # Redelivery rides the loop cadence: each iteration gives spooled
+        # results one (backoff-gated) chance before new work leases.
+        self.flush_spool()
         try:
             leased = self.lease_once()
         except RuntimeError as exc:
             self.rate.log("lease", str(exc))
-            time.sleep(self.config.agent.error_backoff_sec)
+            # Decorrelated jittered backoff (base = error_backoff_sec): a
+            # fleet that lost its controller must not retry in lockstep.
+            time.sleep(self._lease_retry.next_backoff())
             return False
+        self._lease_retry.reset()
         if leased is None:
-            time.sleep(self.config.agent.idle_sleep_sec)
+            # ±25% jitter: a fleet restarted together must not long-poll in
+            # lockstep forever (ISSUE 3 satellite).
+            time.sleep(jittered(self.config.agent.idle_sleep_sec))
             return False
         lease_id, tasks = leased
         for task in tasks:
@@ -583,6 +719,10 @@ class Agent:
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
+        # Last chance for spooled results before exit: force past the
+        # backoff window — anything still undeliverable stays in the on-disk
+        # spool (if configured) for the next incarnation.
+        self.flush_spool(force=True)
         # Final telemetry flush: the last task's counters postdate the last
         # real lease poll, so without this the fleet view would always lag
         # one snapshot behind a finished drain.

@@ -45,6 +45,7 @@ from typing import Any, Dict, Optional
 
 from agent_tpu.utils.errors import structured_error
 from agent_tpu.utils.logging import log
+from agent_tpu.utils.retry import jittered
 
 
 @dataclass
@@ -158,10 +159,13 @@ class PipelineRunner:
                     leased = agent.lease_once()
                 except RuntimeError as exc:
                     agent.rate.log("lease", str(exc))
-                    time.sleep(agent.config.agent.error_backoff_sec)
+                    # Shared retry policy (utils/retry.py): decorrelated
+                    # jittered backoff instead of the old flat sleep.
+                    time.sleep(agent._lease_retry.next_backoff())
                     continue
+                agent._lease_retry.reset()
                 if leased is None:
-                    time.sleep(agent.config.agent.idle_sleep_sec)
+                    time.sleep(jittered(agent.config.agent.idle_sleep_sec))
                     continue
                 lease_id, tasks = leased
                 for task in tasks:
@@ -287,6 +291,10 @@ class PipelineRunner:
         while True:
             item = self.post_q.get()
             if item is _STOP:
+                # Shutdown: force one last redelivery pass past the backoff
+                # window; what stays undeliverable survives in the on-disk
+                # spool (when configured) for the next incarnation.
+                agent.flush_spool(session=session, force=True)
                 break
             agent.m_queue.set(self.post_q.qsize(), queue="post")
             t_fin = time.perf_counter()
@@ -330,7 +338,12 @@ class PipelineRunner:
             agent.post_result(
                 item.lease_id, item.job_id, item.epoch, item.status,
                 result=item.result, error=item.error, session=session,
+                op=item.op,
             )
+            # Spooled redelivery rides the poster cadence (backoff-gated
+            # inside flush_spool) — the pipelined drain heals from a
+            # controller blip the same way the serial loop does.
+            agent.flush_spool(session=session)
             self.tasks_posted += 1
             agent.tasks_done += 1
             agent.m_tasks.inc(op=item.op, status=item.status)

@@ -114,6 +114,20 @@ class AgentConfig:
     # queue between the stager thread and the device loop. 0 = serial loop.
     # Single-host only; multi-host lockstep broadcast stays serial.
     pipeline_depth: int = 2
+    # Fault tolerance (ISSUE 3). Backoff for lease errors and result
+    # redelivery: capped exponential with decorrelated jitter
+    # (utils/retry.py); error_backoff_sec above is kept as the legacy name
+    # for the lease-retry *base* when RETRY_BASE_SEC is unset.
+    retry_base_sec: float = 0.5               # RETRY_BASE_SEC
+    retry_max_sec: float = 30.0               # RETRY_MAX_SEC
+    # Oldest-entry redelivery deadline for spooled results (0 = keep trying
+    # until delivered or evicted by the ring bound).
+    retry_deadline_sec: float = 0.0           # RETRY_DEADLINE_SEC
+    # Result spool: completed results that failed to post are kept in a
+    # bounded ring (and optionally a JSONL file that survives restarts)
+    # and redelivered with backoff instead of dropped.
+    result_spool_path: str = ""               # RESULT_SPOOL_PATH ("" = memory)
+    result_spool_max: int = 512               # RESULT_SPOOL_MAX
 
     @staticmethod
     def from_env() -> "AgentConfig":
@@ -130,6 +144,11 @@ class AgentConfig:
             labels=parse_labels(os.environ.get("AGENT_LABELS", "")),
             tpu_kind=env_str("TPU_KIND", "tpu-v5e"),
             pipeline_depth=max(0, env_int("PIPELINE_DEPTH", 2)),
+            retry_base_sec=env_float("RETRY_BASE_SEC", 0.5),
+            retry_max_sec=env_float("RETRY_MAX_SEC", 30.0),
+            retry_deadline_sec=env_float("RETRY_DEADLINE_SEC", 0.0),
+            result_spool_path=env_str("RESULT_SPOOL_PATH", ""),
+            result_spool_max=max(1, env_int("RESULT_SPOOL_MAX", 512)),
         )
 
 

@@ -30,8 +30,10 @@ Two durability/liveness extras beyond the reference protocol:
 - **Append-only journal** (``journal_path``): submissions, accepted results,
   and expiry requeues are journaled as JSONL; a restarted controller replays
   the file and resumes a half-drained job — completed shards stay completed,
-  in-flight ones re-queue with a bumped epoch so late results from the
-  previous incarnation are fenced. Result *bodies* are durable only for jobs
+  in-flight ones re-queue at their current epoch (journaled fences replay;
+  a result an agent spooled across the restart is accepted rather than
+  re-executed, and the terminal-state guard keeps application at-most-once
+  even if the job was re-leased meanwhile). Result *bodies* are durable only for jobs
   some other job depends on (reduce partials); journaling every drain shard's
   output would duplicate the whole dataset, so operators should fetch map
   results as shards complete (GET ``/v1/jobs/<id>``) or add a reduce stage.
@@ -55,11 +57,23 @@ from agent_tpu.obs.metrics import (
     render_snapshots,
 )
 from agent_tpu.obs.recorder import FlightRecorder
+from agent_tpu.utils.logging import log
+from agent_tpu.utils.retry import PERMANENT, classify_error
 
 PENDING = "pending"
 LEASED = "leased"
 SUCCEEDED = "succeeded"
-FAILED = "failed"
+FAILED = "failed"      # permanent error — retrying cannot fix it
+DEAD = "dead"          # transient failures exhausted the retry budget
+
+# States no result post can move a job out of (ISSUE 3: `dead` joins the
+# terminal set; duplicate completions against any of them are counted, not
+# applied).
+TERMINAL_STATES = (SUCCEEDED, FAILED, DEAD)
+
+# Reference behavior: every failed job got exactly one retry (two attempts
+# total). Kept as the default budget; per-job `max_attempts` overrides.
+DEFAULT_MAX_ATTEMPTS = 2
 
 # Reference default shard size (ref ops/csv_shard.py:62) — the fallback when
 # no worker profile has suggested anything better.
@@ -88,6 +102,11 @@ class Job:
     lease_deadline: float = 0.0
     agent: Optional[str] = None
     attempts: int = 0
+    # Per-job retry budget; None falls back to the controller default.
+    max_attempts: Optional[int] = None
+    # Requeue delay: a retried job is not leasable before this controller-
+    # clock instant, so a crashing op can't hot-loop through the queue.
+    not_before: float = 0.0
     # Controller-clock submit time (queue-wait attribution: submit→lease).
     submitted_at: float = 0.0
     # Jobs that must complete before this one becomes leasable (reduce
@@ -123,13 +142,18 @@ class Controller:
         sweep_interval_sec: Optional[float] = None,
         registry: Optional[MetricsRegistry] = None,
         recorder: Optional[FlightRecorder] = None,
+        max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+        requeue_delay_sec: float = 0.0,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
+        self.max_attempts = max(1, int(max_attempts))
+        self.requeue_delay_sec = max(0.0, float(requeue_delay_sec))
         self._clock = clock
         self._lock = threading.Lock()
         self._jobs: Dict[str, Job] = {}
         self._queue: List[str] = []  # FIFO of pending job ids
         self._faults: List[str] = []  # one-shot armed faults
+        self._fault_plan = None      # seeded probabilistic plan (chaos.py)
         self.stale_results = 0
         self.last_metrics: Dict[str, Any] = {}
         self.last_profile: Dict[str, Any] = {}
@@ -156,7 +180,19 @@ class Controller:
             "duplicate/unknown_job)", ("op", "outcome"))
         self._m_retries = m.counter(
             "controller_retries_total",
-            "Failed jobs re-queued for their one retry", ("op",))
+            "Transiently-failed jobs re-queued within their retry budget",
+            ("op",))
+        self._m_dead = m.counter(
+            "controller_jobs_dead_total",
+            "Jobs that exhausted their retry budget (terminal `dead`)",
+            ("op",))
+        self._m_faults = m.counter(
+            "controller_faults_injected_total",
+            "Chaos faults injected controller-side (one-shot or plan)",
+            ("fault",))
+        self._m_journal_skipped = m.counter(
+            "controller_journal_replay_skipped_total",
+            "Unparseable mid-file journal lines skipped during replay")
         self._m_expirations = m.counter(
             "controller_lease_expirations_total",
             "Leases TTL-expired and re-queued", ("op",))
@@ -203,48 +239,70 @@ class Controller:
         if not os.path.exists(path):
             return
         with open(path, "r", encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
+            lines = f.read().splitlines()
+        skipped: List[int] = []
+        for i, raw in enumerate(lines):
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                if i == len(lines) - 1:
+                    continue  # torn FINAL write from a crash — expected
+                # Mid-file corruption is NOT a torn write: something else
+                # damaged the journal. Skipping silently would quietly
+                # resurrect or lose jobs, so count + warn (ISSUE 3 satellite).
+                skipped.append(i + 1)
+                continue
+            if ev.get("ev") == "submit":
+                after_order = tuple(ev.get("after") or ())
+                raw_max = ev.get("max_attempts")
+                self._jobs[ev["job_id"]] = Job(
+                    job_id=ev["job_id"],
+                    op=ev["op"],
+                    payload=ev.get("payload") or {},
+                    after=set(after_order),
+                    after_order=after_order,
+                    required_labels=ev.get("required_labels") or {},
+                    max_attempts=int(raw_max) if raw_max else None,
+                )
+                self._depended_on.update(after_order)
+            elif ev.get("ev") == "result":
+                job = self._jobs.get(ev.get("job_id"))
+                if job is None:
                     continue
-                try:
-                    ev = json.loads(line)
-                except ValueError:
-                    continue  # torn final write from a crash — ignore
-                if ev.get("ev") == "submit":
-                    after_order = tuple(ev.get("after") or ())
-                    self._jobs[ev["job_id"]] = Job(
-                        job_id=ev["job_id"],
-                        op=ev["op"],
-                        payload=ev.get("payload") or {},
-                        after=set(after_order),
-                        after_order=after_order,
-                        required_labels=ev.get("required_labels") or {},
-                    )
-                    self._depended_on.update(after_order)
-                elif ev.get("ev") == "result":
-                    job = self._jobs.get(ev.get("job_id"))
-                    if job is None:
-                        continue
-                    job.state = ev.get("state", job.state)
+                job.state = ev.get("state", job.state)
+                job.epoch = int(ev.get("epoch", job.epoch))
+                job.attempts = int(ev.get("attempts", job.attempts))
+                job.result = ev.get("result")
+                job.error = ev.get("error")
+            elif ev.get("ev") == "requeue":
+                # Lease-expiry epoch bump: must replay, or a result the
+                # previous incarnation had fenced off could be accepted
+                # after restart (its epoch would collide with ours).
+                job = self._jobs.get(ev.get("job_id"))
+                if job is not None:
                     job.epoch = int(ev.get("epoch", job.epoch))
-                    job.attempts = int(ev.get("attempts", job.attempts))
-                    job.result = ev.get("result")
-                    job.error = ev.get("error")
-                elif ev.get("ev") == "requeue":
-                    # Lease-expiry epoch bump: must replay, or a result the
-                    # previous incarnation had fenced off could be accepted
-                    # after restart (its epoch would collide with ours).
-                    job = self._jobs.get(ev.get("job_id"))
-                    if job is not None:
-                        job.epoch = int(ev.get("epoch", job.epoch))
+        if skipped:
+            self._m_journal_skipped.inc(len(skipped))
+            log(
+                "journal replay skipped unparseable mid-file lines",
+                path=path, count=len(skipped), lines=skipped[:20],
+            )
         # Jobs that were pending or in flight when the previous controller
-        # died re-queue with a bumped epoch: an agent still holding the old
-        # task posts a stale result, which fencing discards.
+        # died re-queue at their CURRENT epoch — deliberately NOT bumped
+        # (ISSUE 3). Every deliberate fence (expiry/retry requeue) was
+        # journaled and already replayed above; bumping here as well would
+        # fence the *good* results agents spooled while the controller was
+        # down, re-executing finished shards on every restart. An agent
+        # whose lease straddled the restart redelivers at the same epoch
+        # and is accepted; if the job was meanwhile re-leased and completed
+        # by someone else, the terminal-state guard rejects the second
+        # application (first wins) — never applied twice either way.
         for job in self._jobs.values():
-            if job.state not in (SUCCEEDED, FAILED):
+            if job.state not in TERMINAL_STATES:
                 job.state = PENDING
-                job.epoch += 1
                 job.lease_id = None
         self._queue = [
             j.job_id for j in self._jobs.values() if j.state == PENDING
@@ -294,8 +352,18 @@ class Controller:
         job_id: Optional[str] = None,
         after: Optional[Sequence[str]] = None,
         required_labels: Optional[Dict[str, Any]] = None,
+        max_attempts: Optional[int] = None,
     ) -> str:
         job_id = job_id or f"job-{uuid.uuid4().hex[:12]}"
+        if max_attempts is not None:
+            if (
+                isinstance(max_attempts, bool)
+                or not isinstance(max_attempts, int)
+                or max_attempts < 1
+            ):
+                raise ValueError(
+                    f"max_attempts must be a positive int, got {max_attempts!r}"
+                )
         required_labels = dict(required_labels or {})
         for k, v in required_labels.items():
             # Non-scalar requirements can never match the AGENT_LABELS
@@ -327,6 +395,7 @@ class Controller:
             after=set(after_order),
             after_order=after_order,
             required_labels=required_labels,
+            max_attempts=max_attempts,
         )
         with self._lock:
             if job_id in self._jobs:
@@ -345,6 +414,7 @@ class Controller:
                     "payload": job.payload,
                     "after": list(after_order),
                     "required_labels": required_labels,
+                    "max_attempts": max_attempts,
                 }
             )
         return job_id
@@ -374,6 +444,7 @@ class Controller:
         reduce_payload: Optional[Dict[str, Any]] = None,
         required_labels: Optional[Dict[str, Any]] = None,
         collect_partials: bool = False,
+        max_attempts: Optional[int] = None,
     ) -> Tuple[List[str], Optional[str]]:
         """Split a CSV dataset into shard tasks (+ optional gated reduce job).
 
@@ -414,6 +485,7 @@ class Controller:
                     payload,
                     job_id=f"shard-{i}-{uuid.uuid4().hex[:8]}",
                     required_labels=required_labels,
+                    max_attempts=max_attempts,
                 )
             )
         reduce_id = None
@@ -426,16 +498,25 @@ class Controller:
                 payload,
                 after=shard_ids,  # ordered: partials materialize shard-order
                 required_labels=required_labels,
+                max_attempts=max_attempts,
             )
         return shard_ids, reduce_id
 
-    # ---- fault injection (one-shot, SURVEY.md §5.3) ----
+    # ---- fault injection (SURVEY.md §5.3, extended by ISSUE 3) ----
 
-    def inject(self, fault: str) -> None:
-        if fault not in ("drop_lease", "duplicate_task", "stale_epoch"):
-            raise ValueError(f"unknown fault {fault!r}")
+    def inject(self, fault: Optional[str] = None, plan: Any = None) -> None:
+        """Arm a one-shot fault by name, or install a seeded probabilistic
+        ``chaos.FaultPlan`` (``inject(plan=...)``) consulted on every lease —
+        sustained, reproducible failure instead of a single shot. Passing
+        ``plan=None`` with no fault name clears an installed plan."""
+        if fault is not None:
+            if fault not in ("drop_lease", "duplicate_task", "stale_epoch"):
+                raise ValueError(f"unknown fault {fault!r}")
+            with self._lock:
+                self._faults.append(fault)
+            return
         with self._lock:
-            self._faults.append(fault)
+            self._fault_plan = plan
 
     def _take_fault(self, fault: str) -> bool:
         # caller holds the lock
@@ -550,12 +631,20 @@ class Controller:
             if max_tasks < 1:
                 self._m_lease.inc(outcome="metrics_only")
                 return None
-            if self._take_fault("drop_lease"):
+            plan = self._fault_plan
+            if self._take_fault("drop_lease") or (
+                plan is not None and plan.decide("drop_lease")
+            ):
                 self._m_lease.inc(outcome="fault_drop")
+                self._m_faults.inc(fault="drop_lease")
                 self.recorder.record("fault", fault="drop_lease", agent=agent)
                 return None
-            duplicate = self._take_fault("duplicate_task")
-            stale = self._take_fault("stale_epoch")
+            duplicate = self._take_fault("duplicate_task") or (
+                plan is not None and plan.decide("duplicate_task")
+            )
+            stale = self._take_fault("stale_epoch") or (
+                plan is not None and plan.decide("stale_epoch")
+            )
 
             lease_id = f"lease-{uuid.uuid4().hex[:12]}"
             now = self._clock()
@@ -567,6 +656,7 @@ class Controller:
                 if (
                     len(tasks) < max(1, max_tasks)
                     and job.state == PENDING
+                    and job.not_before <= now
                     and (not ops or job.op in ops)
                     and self._labels_match(job, labels)
                     and self._deps_done_locked(job)
@@ -606,11 +696,16 @@ class Controller:
                         # second completion must be idempotent/fenced.
                         tasks.append(job.to_task())
                         duplicate = False
+                        self._m_faults.inc(fault="duplicate_task")
+                        self.recorder.record(
+                            "fault", fault="duplicate_task", job_id=job.job_id
+                        )
                     if stale:
                         # Epoch bumps right after leasing → the agent's result
                         # arrives carrying the old epoch and is discarded.
                         job.epoch += 1
                         stale = False
+                        self._m_faults.inc(fault="stale_epoch")
                         self.recorder.record(
                             "fault", fault="stale_epoch", job_id=job.job_id
                         )
@@ -656,8 +751,10 @@ class Controller:
                     lease_id=lease_id, attempt=job.attempts,
                 )
                 return {"accepted": False, "reason": "stale epoch"}
-            if job.state == SUCCEEDED:
-                # Duplicate completion (e.g. duplicate_task fault): first wins.
+            if job.state in TERMINAL_STATES:
+                # Duplicate completion (duplicate_task fault, a result
+                # redelivered after its response was lost): first wins —
+                # terminal states never move, and nothing re-applies.
                 self._m_results.inc(op=job.op, outcome="duplicate")
                 self.recorder.record(
                     "result_rejected", job_id=job_id, op=job.op,
@@ -681,16 +778,36 @@ class Controller:
                 if isinstance(error, dict) else None,
             )
             if job.state == FAILED:
-                # Failed jobs are re-queued once more before sticking failed —
-                # transient op errors (device warmup, fallback) get one retry.
-                if job.attempts <= 1:
+                # Classified retry policy (ISSUE 3): a permanent error
+                # (UnknownOp, malformed payload — re-running cannot fix it)
+                # sticks `failed` immediately without burning retries; a
+                # transient one re-queues until the attempt budget is spent,
+                # then the job lands terminal `dead`. Retried jobs carry a
+                # requeue delay so a crashing op can't hot-loop the queue.
+                budget = job.max_attempts or self.max_attempts
+                if classify_error(error) == PERMANENT:
+                    self.recorder.record(
+                        "permanent_error", job_id=job_id, op=job.op,
+                        error_type=(error or {}).get("type")
+                        if isinstance(error, dict) else None,
+                    )
+                elif job.attempts < budget:
                     job.state = PENDING
                     job.epoch += 1
+                    job.not_before = self._clock() + self.requeue_delay_sec
                     self._queue.append(job.job_id)
                     self._m_retries.inc(op=job.op)
                     self._m_queue_depth.set(len(self._queue))
                     self.recorder.record(
-                        "retry", job_id=job_id, op=job.op, epoch=job.epoch
+                        "retry", job_id=job_id, op=job.op, epoch=job.epoch,
+                        attempt=job.attempts, budget=budget,
+                    )
+                else:
+                    job.state = DEAD
+                    self._m_dead.inc(op=job.op)
+                    self.recorder.record(
+                        "dead", job_id=job_id, op=job.op,
+                        attempts=job.attempts, budget=budget,
                     )
             # Journal the post-decision state (not the raw report): replay
             # applies it verbatim, so a failed-then-requeued job replays as
@@ -747,7 +864,7 @@ class Controller:
     def drained(self) -> bool:
         with self._lock:
             return all(
-                j.state in (SUCCEEDED, FAILED) for j in self._jobs.values()
+                j.state in TERMINAL_STATES for j in self._jobs.values()
             )
 
     def results(self) -> Dict[str, Any]:

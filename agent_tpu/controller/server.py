@@ -92,6 +92,12 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/v1/jobs":
             # Operator surface: submit one job or a sharded CSV job.
             try:
+                # Per-job retry budget (ISSUE 3): absent → controller default.
+                max_attempts = (
+                    int(body["max_attempts"])
+                    if body.get("max_attempts") is not None
+                    else None
+                )
                 if "source_uri" in body:
                     shard_ids, reduce_id = self.controller.submit_csv_job(
                         source_uri=str(body["source_uri"]),
@@ -108,6 +114,7 @@ class _Handler(BaseHTTPRequestHandler):
                         reduce_payload=body.get("reduce_payload"),
                         required_labels=body.get("required_labels"),
                         collect_partials=bool(body.get("collect_partials")),
+                        max_attempts=max_attempts,
                     )
                     self._send(200, {"job_ids": shard_ids, "reduce_id": reduce_id})
                 else:
@@ -115,6 +122,7 @@ class _Handler(BaseHTTPRequestHandler):
                         op=str(body["op"]),
                         payload=body.get("payload"),
                         required_labels=body.get("required_labels"),
+                        max_attempts=max_attempts,
                     )
                     self._send(200, {"job_id": job_id})
             except (KeyError, ValueError, TypeError) as exc:
@@ -216,7 +224,9 @@ class ControllerServer:
 def main() -> int:
     """Standalone controller: ``agent-tpu-controller`` / ``python -m
     agent_tpu.controller.server``. Env: CONTROLLER_HOST (default 0.0.0.0),
-    CONTROLLER_PORT (default 8080), LEASE_TTL_SEC (default 30)."""
+    CONTROLLER_PORT (default 8080), LEASE_TTL_SEC (default 30),
+    MAX_ATTEMPTS (default retry budget, 2), REQUEUE_DELAY_SEC (retried jobs
+    held back this long, default 1)."""
     import signal
 
     from agent_tpu.config import env_float, env_int, env_str
@@ -230,6 +240,8 @@ def main() -> int:
         lease_ttl_sec=ttl,
         journal_path=journal,
         sweep_interval_sec=sweep if sweep > 0 else None,
+        max_attempts=max(1, env_int("MAX_ATTEMPTS", 2)),
+        requeue_delay_sec=env_float("REQUEUE_DELAY_SEC", 1.0),
     )
     server = ControllerServer(controller, host=host, port=port)
     stop = threading.Event()

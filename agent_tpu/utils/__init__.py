@@ -1,6 +1,22 @@
-"""Shared utilities: structured logging, rate limiting, tracing spans, errors."""
+"""Shared utilities: structured logging, rate limiting, tracing spans,
+errors, retry/backoff policy."""
 
 from agent_tpu.utils.logging import RateLimiter, log
 from agent_tpu.utils.errors import OpError, structured_error
+from agent_tpu.utils.retry import (
+    RetryPolicy,
+    classify_error,
+    classify_http,
+    jittered,
+)
 
-__all__ = ["RateLimiter", "log", "OpError", "structured_error"]
+__all__ = [
+    "RateLimiter",
+    "log",
+    "OpError",
+    "structured_error",
+    "RetryPolicy",
+    "classify_error",
+    "classify_http",
+    "jittered",
+]

@@ -1,0 +1,444 @@
+#!/usr/bin/env python3
+"""Chaos soak: drain a multi-shard CSV map-reduce job under a seeded fault
+plan and prove the fault-tolerance invariants hold (ISSUE 3).
+
+Three scenarios per seed, all in-process (real ``Agent`` loop + real
+``Controller`` through ``chaos.LoopbackSession`` — deterministic, no
+sockets, no jax):
+
+1. **Reference drain** — no faults; records the reduce result.
+2. **Chaos drain** — the same job under a ``FaultPlan`` injecting transport
+   drops, fabricated 500s, duplicated result deliveries, lease drops,
+   duplicate tasks, stale epochs, and agent crash-restarts mid-lease.
+   Asserts: every job reaches a terminal state, the reduce output is
+   bit-identical to the reference (volatile timing fields excluded), no
+   result was applied twice (accepted successes == jobs; rejections cover
+   the injected duplicates), and every injected fault is accounted for in
+   metrics (``chaos_faults_injected_total`` agent-side,
+   ``controller_faults_injected_total`` controller-side).
+3. **Controller outage** — results complete while the controller is "down"
+   (shorter than the lease TTL), spool, then redeliver: zero shard
+   re-executions, ``result_post_failures_total`` + redelivery counters
+   observed.
+
+Exit 0 = all seeds clean; 1 = problems (listed one per line). CI runs
+``--seed 7 --shards 16 --quick``; the acceptance bar is ≥3 seeds, e.g.
+``--seeds 7,8,9``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from agent_tpu.agent.app import Agent
+from agent_tpu.chaos import ChaosSession, FaultPlan, GatedSession, LoopbackSession
+from agent_tpu.config import AgentConfig, Config
+from agent_tpu.controller.core import TERMINAL_STATES, Controller
+from agent_tpu.obs.metrics import MetricsRegistry
+
+# Timing fields legitimately differ run to run; everything else in the
+# reduce result must match bit for bit.
+VOLATILE_KEYS = ("compute_time_ms", "duration_ms", "timings", "trace")
+
+
+def canonical(result: Any) -> str:
+    if isinstance(result, dict):
+        result = {k: v for k, v in result.items() if k not in VOLATILE_KEYS}
+    return json.dumps(result, sort_keys=True, default=str)
+
+
+def build_csv(path: str, rows: int) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("id,text,risk\n")
+        for i in range(rows):
+            f.write(f'{i},"record {i}",{(i % 17) * 0.25}\n')
+
+
+def make_agent(
+    controller: Controller,
+    name: str,
+    plan: Optional[FaultPlan] = None,
+    max_tasks: int = 2,
+) -> Agent:
+    cfg = Config(agent=AgentConfig(
+        controller_url="http://loopback", agent_name=name,
+        tasks=("risk_accumulate",), max_tasks=max_tasks,
+        idle_sleep_sec=0.0, error_backoff_sec=0.0,
+        retry_base_sec=0.001, retry_max_sec=0.01,
+    ))
+    registry = MetricsRegistry()
+    session: Any = LoopbackSession(controller)
+    if plan is not None:
+        session = ChaosSession(session, plan, registry=registry)
+    agent = Agent(config=cfg, session=session, registry=registry)
+    agent._profile = {"tier": "chaos-soak"}  # skip hardware probing
+    return agent
+
+
+def submit_job(
+    controller: Controller, csv_path: str, shards: int, rows_per_shard: int
+) -> Tuple[List[str], str]:
+    shard_ids, reduce_id = controller.submit_csv_job(
+        csv_path,
+        total_rows=shards * rows_per_shard,
+        shard_size=rows_per_shard,
+        map_op="risk_accumulate",
+        extra_payload={"field": "risk"},
+        reduce_op="risk_accumulate",
+        collect_partials=True,
+    )
+    return shard_ids, reduce_id
+
+
+def drive_drain(
+    controller: Controller,
+    agents: List[Agent],
+    plan: Optional[FaultPlan],
+    deadline_sec: float,
+) -> Tuple[List[Agent], int, bool]:
+    """Step the agents until the controller drains (or the deadline hits).
+
+    ``agent_crash`` decisions abandon a *granted* lease and replace the
+    agent with a fresh incarnation (same registry — counters continue): the
+    crash-restart-mid-lease fault. Returns (final agents, crashes, drained).
+    """
+    crashes = 0
+    deadline = time.monotonic() + deadline_sec
+    while not controller.drained() and time.monotonic() < deadline:
+        for i, agent in enumerate(agents):
+            agent.flush_spool()
+            try:
+                leased = agent.lease_once()
+            except RuntimeError:
+                continue  # injected lease fault; backoff is irrelevant here
+            if leased is None:
+                continue
+            if plan is not None and plan.decide("agent_crash"):
+                crashes += 1
+                fresh = Agent(
+                    config=agent.config, session=agent.session,
+                    registry=agent.obs, recorder=agent.recorder,
+                )
+                fresh._profile = agent._profile
+                fresh.tasks_done = agent.tasks_done
+                agents[i] = fresh  # the granted lease dies with the old one
+                continue
+            lease_id, tasks = leased
+            for task in tasks:
+                agent.run_task(lease_id, task)
+        # Let the TTL sweeper publish expiries even when every agent idles.
+        controller.sweep()
+    for agent in agents:
+        agent.flush_spool(force=True)
+    return agents, crashes, controller.drained()
+
+
+def executed_total(agents: List[Agent]) -> int:
+    return sum(
+        s.get("value", 0)
+        for a in agents
+        for s in a.obs.snapshot().get("tasks_total", {}).get("series", [])
+    )
+
+
+def counter_total(registry: MetricsRegistry, name: str,
+                  **match: str) -> float:
+    total = 0.0
+    for s in registry.snapshot().get(name, {}).get("series", []):
+        labels = s.get("labels", {})
+        if all(labels.get(k) == v for k, v in match.items()):
+            total += s.get("value", 0)
+    return total
+
+
+def run_reference(csv_path: str, shards: int, rows_per_shard: int,
+                  deadline_sec: float) -> Tuple[str, List[str]]:
+    problems: List[str] = []
+    controller = Controller(lease_ttl_sec=30.0)
+    _, reduce_id = submit_job(controller, csv_path, shards, rows_per_shard)
+    agents = [make_agent(controller, "ref-agent")]
+    _, _, drained = drive_drain(controller, agents, None, deadline_sec)
+    if not drained:
+        problems.append("reference drain did not complete")
+        return "", problems
+    job = controller.job_snapshot(reduce_id)
+    if job["state"] != "succeeded":
+        problems.append(f"reference reduce state {job['state']!r}")
+        return "", problems
+    return canonical(job["result"]), problems
+
+
+def run_chaos(
+    seed: int, csv_path: str, shards: int, rows_per_shard: int,
+    fault_rate: float, n_agents: int, deadline_sec: float,
+    reference: str,
+) -> List[str]:
+    problems: List[str] = []
+    plan = FaultPlan(
+        seed=seed,
+        drop_request=fault_rate * 0.5,
+        drop_response=fault_rate * 0.25,
+        http_500=fault_rate * 0.25,
+        duplicate_result=0.10,
+        drop_lease=0.10,
+        duplicate_task=0.05,
+        stale_epoch=0.05,
+        agent_crash=0.05,
+    )
+    # Short TTL so abandoned leases requeue inside the deadline; a generous
+    # per-job budget because chaos retries must not exhaust it (transport
+    # faults never reach `report`, but stale-epoch re-leases burn attempts).
+    controller = Controller(
+        lease_ttl_sec=0.5, max_attempts=10, requeue_delay_sec=0.01,
+        sweep_interval_sec=0.1,
+    )
+    controller.inject(plan=plan)
+    _, reduce_id = submit_job(controller, csv_path, shards, rows_per_shard)
+    agents = [
+        make_agent(controller, f"chaos-{seed}-{i}", plan=plan)
+        for i in range(n_agents)
+    ]
+    try:
+        agents, crashes, drained = drive_drain(
+            controller, agents, plan, deadline_sec
+        )
+    finally:
+        controller.close()
+
+    n_jobs = shards + 1
+    if not drained:
+        problems.append(
+            f"seed {seed}: chaos drain did not reach terminal states "
+            f"(counts {controller.counts()})"
+        )
+        return problems
+    for state in controller.counts():
+        if state not in TERMINAL_STATES:
+            problems.append(f"seed {seed}: non-terminal state {state!r}")
+    reduce_job = controller.job_snapshot(reduce_id)
+    if reduce_job["state"] != "succeeded":
+        problems.append(
+            f"seed {seed}: reduce state {reduce_job['state']!r} "
+            f"(error {reduce_job['error']!r})"
+        )
+        return problems
+    got = canonical(reduce_job["result"])
+    if got != reference:
+        problems.append(
+            f"seed {seed}: reduce result diverged from fault-free reference\n"
+            f"  want {reference}\n  got  {got}"
+        )
+
+    # No double application: exactly one accepted success per job.
+    accepted = counter_total(
+        controller.metrics, "controller_results_total", outcome="succeeded"
+    )
+    if accepted != n_jobs:
+        problems.append(
+            f"seed {seed}: accepted successes {accepted} != jobs {n_jobs} "
+            "(a result was applied twice or lost)"
+        )
+    # Every duplicate delivery must surface as a counted rejection; the
+    # epoch fence + duplicate guard are the only things standing between an
+    # at-least-once transport and double application.
+    dup_injected = plan.counts.get("duplicate_result", 0)
+    rejected = counter_total(
+        controller.metrics, "controller_results_total", outcome="duplicate"
+    ) + counter_total(
+        controller.metrics, "controller_results_total", outcome="stale_epoch"
+    )
+    if rejected < dup_injected:
+        problems.append(
+            f"seed {seed}: rejections {rejected} < injected duplicate "
+            f"deliveries {dup_injected}"
+        )
+
+    # Fault accounting: agent-side transport injections all land in the
+    # fleet metric; controller-side consumed injections land in the
+    # controller metric (duplicate_task/stale_epoch only *consume* when a
+    # task leases, so metric <= plan count for those).
+    for fault in ("drop_request", "drop_response", "http_500",
+                  "duplicate_result", "delay"):
+        injected = plan.counts.get(fault, 0)
+        observed = sum(
+            counter_total(a.obs, "chaos_faults_injected_total", fault=fault)
+            for a in agents
+        )
+        if observed != injected:
+            problems.append(
+                f"seed {seed}: {fault} metric {observed} != injected {injected}"
+            )
+    drop_lease_metric = counter_total(
+        controller.metrics, "controller_faults_injected_total",
+        fault="drop_lease",
+    )
+    if drop_lease_metric != plan.counts.get("drop_lease", 0):
+        problems.append(
+            f"seed {seed}: drop_lease metric {drop_lease_metric} != "
+            f"injected {plan.counts.get('drop_lease', 0)}"
+        )
+    for fault in ("duplicate_task", "stale_epoch"):
+        consumed = counter_total(
+            controller.metrics, "controller_faults_injected_total",
+            fault=fault,
+        )
+        if consumed > plan.counts.get(fault, 0):
+            problems.append(
+                f"seed {seed}: {fault} consumed {consumed} > decided "
+                f"{plan.counts.get(fault, 0)}"
+            )
+
+    total_injected = plan.total_injected()
+    print(json.dumps({
+        "scenario": "chaos", "seed": seed, "shards": shards,
+        "jobs": n_jobs, "crashes": crashes,
+        "faults_injected": dict(sorted(plan.counts.items())),
+        "total_injected": total_injected,
+        "stale_results": controller.stale_results,
+        "counts": controller.counts(),
+        "ok": not problems,
+    }, sort_keys=True))
+    if total_injected == 0:
+        problems.append(f"seed {seed}: plan injected zero faults — soak vacuous")
+    return problems
+
+
+def run_outage(seed: int, csv_path: str, shards: int, rows_per_shard: int,
+               deadline_sec: float) -> List[str]:
+    """Controller 'outage' shorter than the lease TTL: completed results
+    spool and redeliver; no shard re-executes."""
+    problems: List[str] = []
+    controller = Controller(lease_ttl_sec=60.0)
+    shard_ids, reduce_id = submit_job(
+        controller, csv_path, shards, rows_per_shard
+    )
+    agent = make_agent(controller, f"outage-{seed}", max_tasks=shards)
+    gate = GatedSession(agent.session)
+    agent.session = gate
+
+    # Lease every shard, then lose the controller before anything posts.
+    leased = agent.lease_once()
+    if leased is None:
+        return [f"seed {seed}: outage scenario leased nothing"]
+    lease_id, tasks = leased
+    gate.down = True
+    for task in tasks:
+        agent.run_task(lease_id, task)  # executes; posts spool
+    spooled = len(agent.spool)
+    post_failures = counter_total(agent.obs, "result_post_failures_total")
+    if spooled != len(tasks):
+        problems.append(
+            f"seed {seed}: {spooled} spooled != {len(tasks)} completed"
+        )
+    if post_failures != len(tasks):
+        problems.append(
+            f"seed {seed}: result_post_failures_total {post_failures} != "
+            f"{len(tasks)}"
+        )
+
+    # Controller back inside the lease window → spool drains, reduce runs.
+    gate.down = False
+    delivered = agent.flush_spool(force=True)
+    _, _, drained = drive_drain(controller, [agent], None, deadline_sec)
+    if not drained:
+        problems.append(f"seed {seed}: outage drain did not complete")
+        return problems
+    redelivered = counter_total(
+        agent.obs, "result_redeliveries_total", outcome="delivered"
+    )
+    expired = counter_total(
+        controller.metrics, "controller_lease_expirations_total"
+    )
+    reexecutions = executed_total([agent]) - (shards + 1)
+    for jid in shard_ids:
+        if controller.job_snapshot(jid)["attempts"] != 1:
+            problems.append(f"seed {seed}: shard {jid} re-leased after outage")
+    if reexecutions != 0:
+        problems.append(
+            f"seed {seed}: {reexecutions} re-executions after outage "
+            "(spool should have redelivered instead)"
+        )
+    if delivered != spooled or redelivered != spooled:
+        problems.append(
+            f"seed {seed}: redelivered {redelivered} != spooled {spooled}"
+        )
+    if expired != 0:
+        problems.append(
+            f"seed {seed}: {expired} lease expirations during an outage "
+            "shorter than the TTL"
+        )
+    if controller.job_snapshot(reduce_id)["state"] != "succeeded":
+        problems.append(f"seed {seed}: reduce failed after outage")
+    print(json.dumps({
+        "scenario": "outage", "seed": seed, "shards": shards,
+        "spooled": spooled, "redelivered": redelivered,
+        "post_failures": post_failures, "re_executions": reexecutions,
+        "ok": not problems,
+    }, sort_keys=True))
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--seeds", type=str, default="",
+                    help="comma-separated seed list (overrides --seed)")
+    ap.add_argument("--shards", type=int, default=16)
+    ap.add_argument("--rows-per-shard", type=int, default=50)
+    ap.add_argument("--fault-rate", type=float, default=0.25,
+                    help="total transport-fault probability per request")
+    ap.add_argument("--agents", type=int, default=2)
+    ap.add_argument("--deadline-sec", type=float, default=120.0,
+                    help="per-scenario wall-clock budget")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI sizing: caps shards/rows/deadline for <1 min")
+    args = ap.parse_args(argv)
+
+    shards = args.shards
+    rows = args.rows_per_shard
+    deadline = args.deadline_sec
+    if args.quick:
+        shards = min(shards, 16)
+        rows = min(rows, 25)
+        deadline = min(deadline, 45.0)
+    seeds = (
+        [int(s) for s in args.seeds.split(",") if s.strip()]
+        if args.seeds else [args.seed]
+    )
+
+    problems: List[str] = []
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="chaos_soak_") as tmp:
+        csv_path = os.path.join(tmp, "rows.csv")
+        build_csv(csv_path, shards * rows)
+        reference, ref_problems = run_reference(csv_path, shards, rows,
+                                                deadline)
+        problems += ref_problems
+        if not ref_problems:
+            for seed in seeds:
+                problems += run_chaos(
+                    seed, csv_path, shards, rows, args.fault_rate,
+                    args.agents, deadline, reference,
+                )
+                problems += run_outage(seed, csv_path, shards, rows, deadline)
+
+    elapsed = round(time.monotonic() - t0, 3)
+    if problems:
+        for p in problems:
+            print(p)
+        print(f"FAILED: {len(problems)} problem(s) in {elapsed}s")
+        return 1
+    print(f"chaos soak: OK ({len(seeds)} seed(s), {shards} shards, {elapsed}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

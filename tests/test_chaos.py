@@ -1,0 +1,605 @@
+"""Fault-tolerance layer tests (ISSUE 3): retry policy + classifier, the
+result spool (redelivery, persistence, overflow), classified controller
+retries (`failed` vs `dead`, per-job max_attempts, requeue delay), chaos
+FaultPlan determinism, and plan-driven injection on both sides of the wire.
+"""
+
+import json
+import random
+
+import pytest
+
+from agent_tpu.agent.app import Agent
+from agent_tpu.agent.spool import ResultSpool
+from agent_tpu.chaos import (
+    ChaosSession,
+    ChaosTransportError,
+    FaultPlan,
+    GatedSession,
+    LoopbackSession,
+)
+from agent_tpu.config import AgentConfig, Config
+from agent_tpu.controller.core import Controller
+from agent_tpu.utils.retry import (
+    PERMANENT,
+    TRANSIENT,
+    RetryPolicy,
+    classify_error,
+    classify_http,
+    jittered,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def fast_config(**agent_kw):
+    agent_kw.setdefault("controller_url", "http://loopback")
+    agent_kw.setdefault("idle_sleep_sec", 0.0)
+    agent_kw.setdefault("error_backoff_sec", 0.0)
+    agent_kw.setdefault("retry_base_sec", 0.0)
+    agent_kw.setdefault("retry_max_sec", 0.01)
+    agent_kw.setdefault("tasks", ("echo",))
+    return Config(agent=AgentConfig(**agent_kw))
+
+
+def make_agent(controller, **agent_kw):
+    agent = Agent(
+        config=fast_config(**agent_kw), session=LoopbackSession(controller)
+    )
+    agent._profile = {"tier": "test"}
+    return agent
+
+
+def counter_value(registry, name, **labels):
+    total = 0.0
+    for s in registry.snapshot().get(name, {}).get("series", []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            total += s.get("value", 0)
+    return total
+
+
+# ---- retry policy ----
+
+
+class TestRetryPolicy:
+    def test_backoff_bounded_and_capped(self):
+        policy = RetryPolicy(base_sec=0.1, max_sec=2.0, multiplier=3.0)
+        state = policy.start(rng=random.Random(0))
+        prev = 0.1
+        for _ in range(50):
+            sleep = state.next_backoff()
+            assert 0.1 <= sleep <= 2.0
+            assert sleep <= max(0.1, prev * 3.0) + 1e-9
+            prev = sleep
+
+    def test_backoff_grows_from_base(self):
+        """Decorrelated jitter reaches the cap region given enough failures
+        (a flat sleep never would)."""
+        policy = RetryPolicy(base_sec=0.1, max_sec=10.0)
+        state = policy.start(rng=random.Random(1))
+        sleeps = [state.next_backoff() for _ in range(30)]
+        assert max(sleeps) > 1.0
+
+    def test_reset_restarts_the_streak(self):
+        policy = RetryPolicy(base_sec=0.1, max_sec=100.0)
+        state = policy.start(rng=random.Random(2))
+        for _ in range(20):
+            state.next_backoff()
+        state.reset()
+        assert state.attempts == 0
+        assert state.next_backoff() <= 0.1 * 3.0
+
+    def test_deadline_expiry_uses_clock(self):
+        clock = FakeClock()
+        policy = RetryPolicy(base_sec=0.1, deadline_sec=5.0)
+        state = policy.start(rng=random.Random(3), clock=clock)
+        assert not state.expired()  # never before the first backoff
+        state.next_backoff()
+        assert not state.expired()
+        clock.t = 5.0
+        assert state.expired()
+        state.reset()
+        assert not state.expired()
+
+    def test_zero_base_stays_zero(self):
+        """Tests set error_backoff_sec=0 — the policy must not invent
+        sleeps out of nothing."""
+        state = RetryPolicy(base_sec=0.0, max_sec=1.0).start(
+            rng=random.Random(4)
+        )
+        assert state.next_backoff() == 0.0
+
+    def test_jittered_bounds(self):
+        rng = random.Random(5)
+        for _ in range(200):
+            v = jittered(1.0, frac=0.25, rng=rng)
+            assert 0.75 <= v <= 1.25
+        assert jittered(0.0) == 0.0
+
+
+class TestClassifier:
+    @pytest.mark.parametrize("status,want", [
+        (0, TRANSIENT),      # transport error sentinel
+        (500, TRANSIENT), (503, TRANSIENT), (429, TRANSIENT),
+        (400, PERMANENT), (404, PERMANENT), (422, PERMANENT),
+        (200, TRANSIENT),    # not a failure class; callers gate on success
+        (None, TRANSIENT), ("junk", TRANSIENT),
+    ])
+    def test_http(self, status, want):
+        assert classify_http(status) == want
+
+    @pytest.mark.parametrize("error,want", [
+        ({"type": "UnknownOp"}, PERMANENT),
+        ({"type": "ValueError"}, PERMANENT),
+        ({"type": "OpError"}, PERMANENT),
+        ({"type": "RuntimeError"}, TRANSIENT),
+        ({"type": "OSError"}, TRANSIENT),
+        ("UnknownOp", PERMANENT),
+        (None, TRANSIENT), ({}, TRANSIENT),
+    ])
+    def test_error_types(self, error, want):
+        assert classify_error(error) == want
+
+
+# ---- result spool ----
+
+
+class TestResultSpool:
+    def test_put_head_pop_roundtrip(self):
+        spool = ResultSpool(capacity=4)
+        spool.put("L1", "j1", 0, "succeeded", result={"ok": True}, op="echo")
+        assert len(spool) == 1
+        body = ResultSpool.wire_body(spool.head())
+        assert body == {
+            "lease_id": "L1", "job_id": "j1", "job_epoch": 0,
+            "status": "succeeded", "result": {"ok": True}, "error": None,
+        }
+        assert spool.pop_head()["op"] == "echo"
+        assert len(spool) == 0 and spool.head() is None
+
+    def test_overflow_evicts_oldest(self):
+        spool = ResultSpool(capacity=2)
+        assert spool.put("L", "j1", 0, "succeeded") is None
+        assert spool.put("L", "j2", 0, "succeeded") is None
+        evicted = spool.put("L", "j3", 0, "succeeded")
+        assert evicted["job_id"] == "j1"
+        assert [e["job_id"] for e in spool.entries()] == ["j2", "j3"]
+
+    def test_disk_persistence_survives_restart(self, tmp_path):
+        path = str(tmp_path / "spool.jsonl")
+        s1 = ResultSpool(capacity=8, path=path)
+        s1.put("L", "j1", 3, "succeeded", result={"rows": 5}, op="x")
+        s1.put("L", "j2", 0, "failed", error={"type": "E"}, op="x")
+
+        s2 = ResultSpool(capacity=8, path=path)
+        assert [e["job_id"] for e in s2.entries()] == ["j1", "j2"]
+        assert s2.head()["result"] == {"rows": 5}
+        s2.pop_head()
+        # The pop persisted: a third incarnation sees only j2.
+        s3 = ResultSpool(capacity=8, path=path)
+        assert [e["job_id"] for e in s3.entries()] == ["j2"]
+
+    def test_torn_spool_line_skipped(self, tmp_path):
+        path = tmp_path / "spool.jsonl"
+        path.write_text(
+            json.dumps({"job_id": "ok", "lease_id": "L"}) + "\n"
+            + '{"job_id": "torn", "lease'
+        )
+        spool = ResultSpool(path=str(path))
+        assert [e["job_id"] for e in spool.entries()] == ["ok"]
+        assert spool.load_skipped == 1
+
+    def test_age_of_head(self):
+        clock = FakeClock()
+        spool = ResultSpool(clock=clock)
+        assert spool.age_of_head() == 0.0
+        spool.put("L", "j", 0, "succeeded")
+        clock.t = 4.0
+        assert spool.age_of_head() == 4.0
+
+
+class TestSpoolRedelivery:
+    def test_outage_spools_then_redelivers_without_reexecution(self):
+        """The headline scenario: controller down inside the lease window →
+        the completed result spools, redelivers when it's back; the shard is
+        never re-executed."""
+        controller = Controller(lease_ttl_sec=60.0)
+        jid = controller.submit("echo", {"x": 1})
+        agent = make_agent(controller)
+        gate = GatedSession(agent.session)
+        agent.session = gate
+
+        leased = agent.lease_once()
+        lease_id, tasks = leased
+        gate.down = True
+        agent.run_task(lease_id, tasks[0])
+        assert agent.tasks_done == 1
+        assert len(agent.spool) == 1
+        assert counter_value(
+            agent.obs, "result_post_failures_total", op="echo") == 1
+        assert controller.job(jid).state == "leased"  # nothing arrived
+
+        gate.down = False
+        assert agent.flush_spool(force=True) == 1
+        assert len(agent.spool) == 0
+        assert controller.job(jid).state == "succeeded"
+        assert controller.job(jid).result["echo"] == {"x": 1}
+        assert controller.job(jid).attempts == 1  # no re-execution
+        assert counter_value(
+            agent.obs, "result_redeliveries_total", outcome="delivered") == 1
+
+    def test_flush_respects_backoff_window(self):
+        controller = Controller()
+        agent = make_agent(controller, retry_base_sec=30.0, retry_max_sec=60.0)
+        gate = GatedSession(agent.session)
+        agent.session = gate
+        gate.down = True
+        agent.spool.put("L", "j1", 0, "succeeded", op="echo")
+        assert agent.flush_spool() == 0        # attempt fails → backoff armed
+        tried = gate.rejected
+        assert agent.flush_spool() == 0        # inside the window: no attempt
+        assert gate.rejected == tried
+        assert agent.flush_spool(force=True) == 0  # force bypasses the window
+        assert gate.rejected == tried + 1
+
+    def test_step_drains_spool_before_new_work(self):
+        controller = Controller()
+        j1 = controller.submit("echo", {"first": 1})
+        agent = make_agent(controller)
+        gate = GatedSession(agent.session)
+        agent.session = gate
+
+        leased = agent.lease_once()
+        gate.down = True
+        agent.run_task(leased[0], leased[1][0])
+        assert len(agent.spool) == 1
+        gate.down = False
+        j2 = controller.submit("echo", {"second": 2})
+        agent.step()  # flushes the spool, then leases + executes j2
+        assert controller.job(j1).state == "succeeded"
+        assert controller.job(j2).state == "succeeded"
+        assert len(agent.spool) == 0
+
+    def test_spooled_stale_result_drains_as_counted_noop(self):
+        """Redelivery of a result whose lease TTL-expired mid-outage: the
+        fence rejects it (HTTP 200, accepted=False) — the spool must treat
+        that as delivered, not retry forever."""
+        clock = FakeClock()
+        controller = Controller(lease_ttl_sec=5.0, clock=clock)
+        jid = controller.submit("echo", {})
+        agent = make_agent(controller)
+        gate = GatedSession(agent.session)
+        agent.session = gate
+        leased = agent.lease_once()
+        gate.down = True
+        agent.run_task(leased[0], leased[1][0])
+        clock.t = 10.0
+        controller.sweep()  # outage outlived the TTL: epoch fenced
+        gate.down = False
+        assert agent.flush_spool(force=True) == 1
+        assert len(agent.spool) == 0
+        assert controller.stale_results == 1
+        assert controller.job(jid).state == "pending"  # re-queued, correct
+
+    def test_overflow_and_expiry_counted(self):
+        controller = Controller()
+        agent = make_agent(controller, result_spool_max=1,
+                           retry_deadline_sec=0.0)
+        gate = GatedSession(agent.session)
+        agent.session = gate
+        gate.down = True
+        agent.post_result("L", "j1", 0, "succeeded", result={}, op="echo")
+        agent.post_result("L", "j2", 0, "succeeded", result={}, op="echo")
+        assert len(agent.spool) == 1  # j1 evicted
+        assert agent.spool.head()["job_id"] == "j2"
+        assert counter_value(
+            agent.obs, "result_redeliveries_total",
+            outcome="dropped_overflow") == 1
+
+    def test_controller_restart_accepts_spooled_result(self, tmp_path):
+        """The tentpole scenario: the CONTROLLER restarts (journal replay)
+        inside the lease window while the agent holds a completed, spooled
+        result — redelivery to the new incarnation is accepted, so the
+        finished shard is never re-executed."""
+        journal = str(tmp_path / "controller.jsonl")
+        c1 = Controller(lease_ttl_sec=60.0, journal_path=journal)
+        jid = c1.submit("echo", {"x": 7})
+        agent = make_agent(c1)
+        gate = GatedSession(agent.session)
+        agent.session = gate
+        leased = agent.lease_once()
+        gate.down = True
+        agent.run_task(leased[0], leased[1][0])  # completes; post spools
+        assert len(agent.spool) == 1
+        c1.close()  # controller dies with the result undelivered
+
+        c2 = Controller(lease_ttl_sec=60.0, journal_path=journal)
+        agent.session = LoopbackSession(c2)  # new incarnation, back up
+        assert agent.flush_spool(force=True) == 1
+        job = c2.job_snapshot(jid)
+        assert job["state"] == "succeeded"
+        assert job["result"]["echo"] == {"x": 7}
+        assert agent.tasks_done == 1  # executed exactly once, ever
+        c2.close()
+
+    def test_restart_rerace_applies_at_most_once(self, tmp_path):
+        """If the restarted controller re-leased the job before the original
+        agent's redelivery lands, first completion wins and the second is a
+        counted duplicate — never applied twice."""
+        journal = str(tmp_path / "controller.jsonl")
+        c1 = Controller(lease_ttl_sec=60.0, journal_path=journal)
+        jid = c1.submit("echo", {"x": 1})
+        agent = make_agent(c1)
+        gate = GatedSession(agent.session)
+        agent.session = gate
+        leased = agent.lease_once()
+        gate.down = True
+        agent.run_task(leased[0], leased[1][0])
+        c1.close()
+
+        c2 = Controller(lease_ttl_sec=60.0, journal_path=journal)
+        # A second agent drains the re-queued job first.
+        other = make_agent(c2)
+        lease2 = other.lease_once()
+        other.run_task(lease2[0], lease2[1][0])
+        assert c2.job_snapshot(jid)["state"] == "succeeded"
+        # The original redelivery is rejected by the terminal guard.
+        agent.session = LoopbackSession(c2)
+        assert agent.flush_spool(force=True) == 1  # delivered = decided
+        assert counter_value(
+            c2.metrics, "controller_results_total", outcome="duplicate") == 1
+        assert counter_value(
+            c2.metrics, "controller_results_total", outcome="succeeded") == 1
+        c2.close()
+
+    def test_agent_restart_redelivers_from_disk_spool(self, tmp_path):
+        """RESULT_SPOOL_PATH: a crashed agent's undelivered results survive
+        into the next incarnation and redeliver from there."""
+        spool_path = str(tmp_path / "spool.jsonl")
+        controller = Controller(lease_ttl_sec=60.0)
+        jid = controller.submit("echo", {"x": 9})
+        a1 = make_agent(controller, result_spool_path=spool_path)
+        gate = GatedSession(a1.session)
+        a1.session = gate
+        leased = a1.lease_once()
+        gate.down = True
+        a1.run_task(leased[0], leased[1][0])
+        assert len(a1.spool) == 1  # crash here: a1 is abandoned
+
+        a2 = make_agent(controller, result_spool_path=spool_path)
+        assert len(a2.spool) == 1  # loaded from disk
+        assert a2.flush_spool(force=True) == 1
+        assert controller.job(jid).state == "succeeded"
+        assert controller.job(jid).attempts == 1
+
+
+# ---- classified controller retries ----
+
+
+class TestClassifiedRetries:
+    def test_permanent_error_sticks_failed_immediately(self):
+        c = Controller(max_attempts=5)
+        jid = c.submit("nope", {})
+        lease = c.lease("a", {"ops": ["nope"]})
+        c.report(lease["lease_id"], jid, 0, "failed",
+                 error={"type": "UnknownOp", "message": "no such op"})
+        job = c.job(jid)
+        assert job.state == "failed"
+        assert job.attempts == 1  # no retry burned
+        assert c.drained()
+        assert counter_value(c.metrics, "controller_retries_total") == 0
+
+    def test_transient_errors_retry_until_dead(self):
+        c = Controller(max_attempts=3)
+        jid = c.submit("echo", {})
+        for attempt in range(3):
+            lease = c.lease("a", {"ops": ["echo"]})
+            assert lease is not None, f"attempt {attempt + 1} did not lease"
+            c.report(lease["lease_id"], jid, lease["tasks"][0]["job_epoch"],
+                     "failed", error={"type": "RuntimeError"})
+        job = c.job(jid)
+        assert job.state == "dead" and job.attempts == 3
+        assert c.drained()
+        assert counter_value(
+            c.metrics, "controller_jobs_dead_total", op="echo") == 1
+        assert counter_value(c.metrics, "controller_retries_total") == 2
+        assert c.counts() == {"dead": 1}  # surfaced via /v1/status counts
+
+    def test_per_job_max_attempts_overrides_default(self):
+        c = Controller(max_attempts=2)
+        jid = c.submit("echo", {}, max_attempts=1)
+        lease = c.lease("a", {"ops": ["echo"]})
+        c.report(lease["lease_id"], jid, lease["tasks"][0]["job_epoch"],
+                 "failed", error={"type": "RuntimeError"})
+        assert c.job(jid).state == "dead"  # no retry at all
+
+    def test_submit_rejects_bad_max_attempts(self):
+        c = Controller()
+        for bad in (0, -1, True, 1.5, "3"):
+            with pytest.raises(ValueError):
+                c.submit("echo", {}, max_attempts=bad)
+
+    def test_requeue_delay_prevents_hot_loop(self):
+        clock = FakeClock()
+        c = Controller(clock=clock, max_attempts=5, requeue_delay_sec=2.0)
+        jid = c.submit("echo", {})
+        lease = c.lease("a", {"ops": ["echo"]})
+        c.report(lease["lease_id"], jid, 0, "failed",
+                 error={"type": "RuntimeError"})
+        assert c.job(jid).state == "pending"
+        assert c.lease("a", {"ops": ["echo"]}) is None  # held back
+        clock.t = 2.1
+        assert c.lease("a", {"ops": ["echo"]}) is not None
+
+    def test_max_attempts_honored_across_journal_replay(self, tmp_path):
+        journal = str(tmp_path / "c.jsonl")
+        c1 = Controller(journal_path=journal, max_attempts=2)
+        jid = c1.submit("echo", {}, max_attempts=3)
+        for _ in range(2):
+            lease = c1.lease("a", {"ops": ["echo"]})
+            c1.report(lease["lease_id"], jid, lease["tasks"][0]["job_epoch"],
+                      "failed", error={"type": "RuntimeError"})
+        assert c1.job(jid).state == "pending"  # 2 of 3 attempts burned
+        c1.close()
+
+        c2 = Controller(journal_path=journal, max_attempts=2)
+        job = c2.job(jid)
+        assert job.state == "pending" and job.attempts == 2
+        assert job.max_attempts == 3  # the per-job budget replayed
+        lease = c2.lease("a", {"ops": ["echo"]})
+        c2.report(lease["lease_id"], jid, lease["tasks"][0]["job_epoch"],
+                  "failed", error={"type": "RuntimeError"})
+        assert c2.job(jid).state == "dead"
+        c2.close()
+
+    def test_dead_state_survives_restart(self, tmp_path):
+        journal = str(tmp_path / "c.jsonl")
+        c1 = Controller(journal_path=journal, max_attempts=1)
+        jid = c1.submit("echo", {})
+        lease = c1.lease("a", {"ops": ["echo"]})
+        c1.report(lease["lease_id"], jid, 0, "failed",
+                  error={"type": "RuntimeError"})
+        assert c1.job(jid).state == "dead"
+        c1.close()
+        c2 = Controller(journal_path=journal, max_attempts=1)
+        assert c2.job(jid).state == "dead"  # terminal: not re-queued
+        assert c2.lease("a", {"ops": ["echo"]}) is None
+        c2.close()
+
+    def test_duplicate_success_after_dead_is_rejected(self):
+        c = Controller(max_attempts=1)
+        jid = c.submit("echo", {})
+        lease = c.lease("a", {"ops": ["echo"]})
+        epoch = lease["tasks"][0]["job_epoch"]
+        c.report(lease["lease_id"], jid, epoch, "failed",
+                 error={"type": "RuntimeError"})
+        out = c.report(lease["lease_id"], jid, epoch, "succeeded", {"late": 1})
+        assert out["accepted"] is False
+        assert c.job(jid).state == "dead"
+
+
+# ---- chaos plan + sessions ----
+
+
+class TestFaultPlan:
+    def test_same_seed_same_decisions(self):
+        kinds = ["drop_request", "http_500", "drop_response"] * 40
+        p1 = FaultPlan(seed=42, drop_request=0.3, http_500=0.2,
+                       drop_response=0.1)
+        p2 = FaultPlan(seed=42, drop_request=0.3, http_500=0.2,
+                       drop_response=0.1)
+        seq1 = [p1.decide(k) for k in kinds]
+        seq2 = [p2.decide(k) for k in kinds]
+        assert seq1 == seq2
+        assert p1.counts == p2.counts
+        assert any(seq1)  # the plan actually fires at these rates
+
+    def test_different_seed_diverges(self):
+        kinds = ["drop_request"] * 200
+        p1 = FaultPlan(seed=1, drop_request=0.5)
+        p2 = FaultPlan(seed=2, drop_request=0.5)
+        assert [p1.decide(k) for k in kinds] != [p2.decide(k) for k in kinds]
+
+    def test_zero_probability_consumes_no_randomness(self):
+        p1 = FaultPlan(seed=7, drop_request=0.5)
+        p2 = FaultPlan(seed=7, drop_request=0.5, http_500=0.0)
+        seq1 = [p1.decide("drop_request") for _ in range(50)]
+        seq2 = []
+        for _ in range(50):
+            p2.decide("http_500")  # disabled: must not perturb the stream
+            seq2.append(p2.decide("drop_request"))
+        assert seq1 == seq2
+
+    def test_counts_tally_hits(self):
+        p = FaultPlan(seed=3, drop_request=1.0)
+        for _ in range(5):
+            assert p.decide("drop_request")
+        assert p.counts == {"drop_request": 5}
+        assert p.total_injected() == 5
+
+
+class TestChaosSession:
+    def test_drop_request_never_reaches_controller(self):
+        controller = Controller()
+        controller.submit("echo", {})
+        plan = FaultPlan(seed=0, drop_request=1.0)
+        agent = make_agent(controller)
+        agent.session = ChaosSession(LoopbackSession(controller), plan,
+                                     registry=agent.obs)
+        with pytest.raises(RuntimeError, match="transport"):
+            agent.lease_once()
+        assert controller.job(controller._queue[0]).state == "pending"
+        assert counter_value(
+            agent.obs, "chaos_faults_injected_total",
+            fault="drop_request", path="leases") == 1
+
+    def test_http_500_after_delivery_forces_fenced_redelivery(self):
+        """The nastiest transport fault: the controller APPLIED the result
+        but the agent was told 500 — redelivery must be a counted no-op."""
+        controller = Controller()
+        jid = controller.submit("echo", {"x": 1})
+        plan = FaultPlan(seed=0, http_500=1.0)
+        agent = make_agent(controller)
+        chaos = ChaosSession(LoopbackSession(controller), plan,
+                             registry=agent.obs)
+        leased = agent.lease_once()  # plain session
+        agent.session = chaos        # faults start now
+        agent.run_task(leased[0], leased[1][0])
+        assert controller.job(jid).state == "succeeded"  # was applied
+        assert len(agent.spool) == 1                     # agent disagrees
+        agent.session = LoopbackSession(controller)      # fault clears
+        assert agent.flush_spool(force=True) == 1
+        assert counter_value(
+            controller.metrics, "controller_results_total",
+            outcome="duplicate") == 1
+        assert counter_value(
+            controller.metrics, "controller_results_total",
+            outcome="succeeded") == 1  # applied exactly once
+
+    def test_duplicate_result_applied_once(self):
+        controller = Controller()
+        jid = controller.submit("echo", {})
+        plan = FaultPlan(seed=0, duplicate_result=1.0)
+        agent = make_agent(controller)
+        leased = agent.lease_once()
+        agent.session = ChaosSession(LoopbackSession(controller), plan,
+                                     registry=agent.obs)
+        agent.run_task(leased[0], leased[1][0])
+        assert controller.job(jid).state == "succeeded"
+        assert counter_value(
+            controller.metrics, "controller_results_total",
+            outcome="duplicate") == 1
+        assert len(agent.spool) == 0  # first response was the success
+
+    def test_controller_plan_injection(self):
+        controller = Controller()
+        plan = FaultPlan(seed=0, drop_lease=1.0)
+        controller.inject(plan=plan)
+        controller.submit("echo", {})
+        assert controller.lease("a", {"ops": ["echo"]}) is None
+        assert counter_value(
+            controller.metrics, "controller_faults_injected_total",
+            fault="drop_lease") == 1
+        controller.inject(plan=None)  # cleared
+        assert controller.lease("a", {"ops": ["echo"]}) is not None
+
+    def test_controller_plan_duplicate_task_and_stale_epoch(self):
+        controller = Controller()
+        controller.inject(plan=FaultPlan(seed=0, duplicate_task=1.0))
+        controller.submit("echo", {}, job_id="dup")
+        lease = controller.lease("a", {"ops": ["echo"]})
+        assert [t["id"] for t in lease["tasks"]] == ["dup", "dup"]
+
+        c2 = Controller()
+        c2.inject(plan=FaultPlan(seed=0, stale_epoch=1.0))
+        jid = c2.submit("echo", {})
+        lease = c2.lease("a", {"ops": ["echo"]})
+        out = c2.report(lease["lease_id"], jid,
+                        lease["tasks"][0]["job_epoch"], "succeeded", {})
+        assert out["accepted"] is False and out["reason"] == "stale epoch"

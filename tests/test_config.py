@@ -51,6 +51,30 @@ def test_env_overrides(monkeypatch):
     assert cfg.device.mesh_shape == {"dp": 2, "tp": 4}
 
 
+def test_fault_tolerance_env_knobs(monkeypatch):
+    for var in ("RETRY_BASE_SEC", "RETRY_MAX_SEC", "RETRY_DEADLINE_SEC",
+                "RESULT_SPOOL_PATH", "RESULT_SPOOL_MAX"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = AgentConfig.from_env()
+    assert cfg.retry_base_sec == 0.5
+    assert cfg.retry_max_sec == 30.0
+    assert cfg.retry_deadline_sec == 0.0
+    assert cfg.result_spool_path == ""
+    assert cfg.result_spool_max == 512
+
+    monkeypatch.setenv("RETRY_BASE_SEC", "0.1")
+    monkeypatch.setenv("RETRY_MAX_SEC", "5")
+    monkeypatch.setenv("RETRY_DEADLINE_SEC", "120")
+    monkeypatch.setenv("RESULT_SPOOL_PATH", "/tmp/spool.jsonl")
+    monkeypatch.setenv("RESULT_SPOOL_MAX", "0")  # floored at 1
+    cfg = AgentConfig.from_env()
+    assert cfg.retry_base_sec == 0.1
+    assert cfg.retry_max_sec == 5.0
+    assert cfg.retry_deadline_sec == 120.0
+    assert cfg.result_spool_path == "/tmp/spool.jsonl"
+    assert cfg.result_spool_max == 1
+
+
 def test_forgiving_parses(monkeypatch):
     # Bad values fall back to defaults (reference worker_sizing.py:12-41).
     monkeypatch.setenv("MAX_TASKS", "not-a-number")

@@ -122,7 +122,10 @@ def test_failed_job_retried_once():
     t2 = lease2["tasks"][0]
     assert t2["job_epoch"] == t["job_epoch"] + 1
     c.report(lease2["lease_id"], jid, t2["job_epoch"], "failed", error={"type": "X"})
-    assert c.job(jid).state == "failed"  # sticks after the retry
+    # Transient-class error + exhausted budget → terminal `dead` (ISSUE 3;
+    # the pre-fault-tolerance controller stuck these `failed`).
+    assert c.job(jid).state == "dead"
+    assert c.drained()
 
 
 def test_duplicate_job_id_rejected():

@@ -133,15 +133,23 @@ class TestJournalResume:
             assert snap["state"] == "succeeded"
             assert snap["result"]["ok"] is True
 
-        # The previous incarnation's in-flight agent posts late: fenced.
+        # The previous incarnation's in-flight agent redelivers its
+        # completed result across the restart: ACCEPTED (ISSUE 3 — replay
+        # no longer blanket-bumps epochs, so spooled work is salvaged
+        # instead of re-executed).
         out = c2.report(
             inflight["lease_id"], inflight_task["id"],
-            inflight_task["job_epoch"], "succeeded", {"ok": True},
+            inflight_task["job_epoch"], "succeeded",
+            {"ok": True, "rows": [inflight_task["payload"]["start_row"]]},
         )
-        assert out["accepted"] is False and out["reason"] == "stale epoch"
+        assert out["accepted"] is True
+        # Done without a single post-restart lease (attempts only journal
+        # inside result events, so the replayed count restarts at 0).
+        snap = c2.job_snapshot(inflight_task["id"])
+        assert snap["state"] == "succeeded" and snap["attempts"] == 0
 
-        # Finish the remaining shards; reduce leases with ordered partials.
-        self._drain_some(c2, 2)
+        # Finish the remaining shard; reduce leases with ordered partials.
+        self._drain_some(c2, 1)
         lease = c2.lease("a1", {"ops": ["risk_accumulate"]})
         assert lease is not None
         partials = lease["tasks"][0]["payload"]["partials"]
@@ -160,13 +168,14 @@ class TestJournalResume:
         c2 = Controller(journal_path=journal)
         job = c2.job(jid)
         assert job.state == "pending" and job.attempts == 1
-        # Fails again after restart → sticks failed (retry budget remembered).
+        # Fails again after restart → retry budget remembered across the
+        # replay; a transient-class error exhausting it lands `dead`.
         lease = c2.lease("a", {"ops": ["echo"]})
         c2.report(
             lease["lease_id"], jid, lease["tasks"][0]["job_epoch"],
             "failed", error={"type": "X"},
         )
-        assert c2.job(jid).state == "failed"
+        assert c2.job(jid).state == "dead"
         c2.close()
 
     def test_expiry_epoch_bumps_survive_restart(self, tmp_path):
@@ -186,14 +195,17 @@ class TestJournalResume:
         c1.close()                                     # crash
 
         c2 = Controller(journal_path=journal)
-        # B (fenced at epoch 1 by the old incarnation) posts late: rejected.
+        # B (fenced at epoch 1 by the old incarnation) posts late: rejected —
+        # the journaled requeue fences replay verbatim.
         out = c2.report(lease_b["lease_id"], jid, 1, "succeeded", {"ok": True})
         assert out["accepted"] is False and out["reason"] == "stale epoch"
         out = c2.report(lease_a["lease_id"], jid, 0, "succeeded", {"ok": True})
         assert out["accepted"] is False
-        # The job is re-leasable at an epoch past every fenced one.
+        # The job is re-leasable at C's epoch (in-flight epochs are NOT
+        # bumped at replay — ISSUE 3: C's spooled result must stay
+        # deliverable), still past every journaled fence.
         lease = c2.lease("d", {"ops": ["echo"]})
-        assert lease["tasks"][0]["job_epoch"] >= 3
+        assert lease["tasks"][0]["job_epoch"] == 2
         c2.close()
 
     def test_undepended_result_bodies_not_journaled(self, tmp_path):
@@ -245,6 +257,31 @@ class TestJournalResume:
 
         c2 = Controller(journal_path=str(journal))
         assert "keep" in [t["id"] for t in c2.lease("a", {"ops": ["echo"]})["tasks"]]
+        c2.close()
+
+    def test_corrupted_midfile_lines_warned_and_counted(self, tmp_path):
+        """Mid-file corruption is NOT a torn final write: replay must skip
+        it loudly (warning + counter), keep every parseable line, and still
+        tolerate a torn LAST line silently (ISSUE 3 satellite)."""
+        journal = tmp_path / "c.jsonl"
+        c1 = Controller(journal_path=str(journal))
+        c1.submit("echo", {"x": 1}, job_id="first")
+        c1.submit("echo", {"x": 2}, job_id="second")
+        c1.close()
+        lines = journal.read_text().splitlines()
+        lines.insert(1, "GARBAGE not json at all")   # mid-file corruption
+        lines.insert(2, '{"ev": "submit", "job_id"')  # truncated mid-file too
+        lines.append('{"ev": "submit", "job_id": "torn", "op"')  # torn final
+        journal.write_text("\n".join(lines))
+
+        c2 = Controller(journal_path=str(journal))
+        ids = {t["id"] for t in c2.lease(
+            "a", {"ops": ["echo"]}, max_tasks=10)["tasks"]}
+        assert ids == {"first", "second"}  # both parseable jobs survive
+        snap = c2.metrics.snapshot()
+        series = snap["controller_journal_replay_skipped_total"]["series"]
+        # Both mid-file bad lines counted; the torn final line is NOT.
+        assert series[0]["value"] == 2
         c2.close()
 
     def test_no_journal_no_files(self, tmp_path):

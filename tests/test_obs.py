@@ -356,15 +356,15 @@ def test_trace_propagates_into_result_bodies(runtime, tmp_path):
 
 def test_flight_recorder_dumps_correlate_across_both_sides(runtime, tmp_path):
     """Injected failure: a missing shard file hard-fails a job (retry, then
-    stuck failed). Dumps from the agent and controller recorders both carry
-    the job's trace-correlated events."""
+    terminal `dead` once the budget is spent). Dumps from the agent and
+    controller recorders both carry the job's trace-correlated events."""
     c = Controller()
     jid = c.submit("map_classify_tpu",
                    {"source_uri": str(tmp_path / "missing.csv"),
                     "start_row": 0, "shard_size": 8})
     with ControllerServer(c) as server:
         agent = _drain_pipelined(c, server, runtime)
-    assert c.job_snapshot(jid)["state"] == "failed"
+    assert c.job_snapshot(jid)["state"] == "dead"
 
     a_path = str(tmp_path / "agent.jsonl")
     c_path = str(tmp_path / "controller.jsonl")

@@ -175,7 +175,9 @@ def test_pipelined_mixed_ops_and_errors(runtime, tmp_path):
     assert c.job_snapshot(soft_id)["state"] == "succeeded"
     assert c.job_snapshot(soft_id)["result"]["ok"] is False
     hard = c.job_snapshot(hard_id)
-    assert hard["state"] == "failed"  # retried once, then stuck failed
+    # Transient-class error (an I/O failure could heal), budget exhausted
+    # after the one retry → terminal `dead` (ISSUE 3).
+    assert hard["state"] == "dead"
     assert hard["error"]["type"] in ("FileNotFoundError", "OSError")
     assert hard["attempts"] == 2
 
