@@ -178,6 +178,12 @@ class Agent:
         self.handlers: Dict[str, OpFn] = load_ops(list(self.config.agent.tasks))
         self._profile: Optional[Dict[str, Any]] = None
         self.tasks_done = 0
+        # Live staged-queue depth source (set by PipelineRunner); the serial
+        # loop has no staging queue, so it falls back to the obs gauge
+        # (which is 0 unless a pipeline ever ran). Shipped in the lease
+        # ``capabilities`` so the controller's scheduler can steer bulk work
+        # away from backed-up agents and shrink grants (ISSUE 4).
+        self.staged_depth_fn: Optional[Any] = None
 
     # ---- controller I/O ----
 
@@ -209,6 +215,38 @@ class Agent:
 
             self._profile = build_worker_profile(self.config)
         return self._profile
+
+    def _staged_depth(self) -> int:
+        if self.staged_depth_fn is not None:
+            try:
+                return max(0, int(self.staged_depth_fn()))
+            except Exception:  # noqa: BLE001 — telemetry must never kill a lease
+                return 0
+        try:
+            return max(0, int(self.m_queue.value(queue="staged")))
+        except Exception:  # noqa: BLE001
+            return 0
+
+    def capabilities(self) -> Dict[str, Any]:
+        """The lease ``capabilities`` body: ops plus the scheduler-facing
+        enrichment (ISSUE 4) — ``device_kind``/``mesh_devices`` from
+        ``TpuRuntime.describe()`` and the current staged ``queue_depth``.
+        Shipped regardless of the controller's SCHED_POLICY (fifo ignores
+        it; fair uses it for placement and grant sizing). A runtime that
+        hasn't been built yet is NOT forced into existence here — pure-host
+        agents never touch jax, so the device fields are simply absent."""
+        caps: Dict[str, Any] = {
+            "ops": sorted(self.handlers),
+            "queue_depth": self._staged_depth(),
+        }
+        if self.runtime is not None:
+            try:
+                desc = self.runtime.describe()
+                caps["device_kind"] = desc.get("platform")
+                caps["mesh_devices"] = desc.get("n_devices")
+            except Exception:  # noqa: BLE001 — telemetry must never kill a lease
+                pass
+        return caps
 
     def _metrics(self) -> Dict[str, Any]:
         m = collect_host_metrics()
@@ -289,7 +327,7 @@ class Agent:
             "/v1/leases",
             {
                 "agent": a.agent_name,
-                "capabilities": {"ops": sorted(self.handlers)},
+                "capabilities": self.capabilities(),
                 "max_tasks": a.max_tasks,
                 "timeout_ms": a.lease_timeout_ms,
                 "labels": a.labels,

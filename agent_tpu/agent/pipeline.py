@@ -92,6 +92,12 @@ class PipelineRunner:
         # in-flight shards — an unbounded post queue would pin device
         # output buffers without limit when the poster falls behind.
         self.post_q: "queue.Queue" = queue.Queue(maxsize=self.depth + 1)
+        # Live load advertisement (ISSUE 4): the stager's lease polls ship
+        # the CURRENT staged-queue occupancy in capabilities.queue_depth, so
+        # the controller's fair scheduler can shrink this agent's grants and
+        # steer bulk shards to idler agents while we're backed up. (The obs
+        # gauge lags a queue transition; the qsize read does not.)
+        agent.staged_depth_fn = self.staged_q.qsize
         self.tasks_posted = 0
         self._stager = threading.Thread(
             target=self._stage_loop, name="agent-stager", daemon=True

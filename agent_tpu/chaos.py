@@ -117,6 +117,8 @@ def _path_of(url: str) -> str:
         return "leases"
     if url.endswith("/v1/results"):
         return "results"
+    if url.endswith("/v1/jobs"):
+        return "jobs"
     return "other"
 
 
@@ -154,6 +156,33 @@ class LoopbackSession:
                 error=body.get("error"),
             )
             return _FakeResponse(200, out)
+        if path == "jobs":
+            # Single-job submit with the scheduling fields (ISSUE 4) — the
+            # same dispatch controller/server.py does, including the 429
+            # admission response, so soaks can exercise backpressure
+            # in-process.
+            from agent_tpu.sched import AdmissionError
+
+            try:
+                job_id = self.controller.submit(
+                    op=str(body.get("op", "")),
+                    payload=body.get("payload"),
+                    required_labels=body.get("required_labels"),
+                    max_attempts=body.get("max_attempts"),
+                    priority=body.get("priority"),
+                    tenant=body.get("tenant"),
+                    deadline_sec=body.get("deadline_sec"),
+                )
+            except AdmissionError as exc:
+                return _FakeResponse(429, {
+                    "error": str(exc),
+                    "retry_after_ms": exc.retry_after_ms,
+                    "tenant": exc.tenant,
+                    "scope": exc.scope,
+                })
+            except (KeyError, ValueError, TypeError) as exc:
+                return _FakeResponse(400, {"error": str(exc)})
+            return _FakeResponse(200, {"job_id": job_id})
         return _FakeResponse(404, {"error": f"no route {url}"})
 
 

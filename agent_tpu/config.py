@@ -250,6 +250,67 @@ class SizingConfig:
 
 
 @dataclass(frozen=True)
+class SchedConfig:
+    """Controller scheduler knobs (ISSUE 4 — the SCHED_* env surface).
+
+    ``policy="fifo"`` (the default) is bit-compatible with the
+    pre-scheduler controller: priority/tenant fields are accepted and
+    recorded but dispatch order is pure arrival order and admission is
+    unbounded unless a budget is set. ``policy="fair"`` enables priority
+    tiers + weighted tenant fair-share + load-aware placement
+    (``agent_tpu/sched/fair.py``).
+    """
+
+    policy: str = "fifo"                 # SCHED_POLICY: fifo | fair
+    # Default priority for submits that don't carry one (0–9, 9 = urgent).
+    default_priority: int = 4            # SCHED_DEFAULT_PRIORITY
+    # Admission control: pending-queue budgets; 0 = unbounded. Submits past
+    # a bound get HTTP 429 + retry_after_ms (transient per utils/retry.py).
+    max_pending: int = 0                 # SCHED_MAX_PENDING (global)
+    max_pending_per_tenant: int = 0      # SCHED_MAX_PENDING_PER_TENANT
+    retry_after_ms: int = 1000           # SCHED_RETRY_AFTER_MS (429 hint)
+    # Fair-share weights, "tenantA=3,tenantB=1" (absent tenants weigh 1).
+    tenant_weights: Dict[str, float] = field(default_factory=dict)
+    # Placement: how many leases a preferred-elsewhere job may be deferred
+    # before any capable agent takes it (0 = placement is advisory only).
+    placement_patience: int = 3          # SCHED_PLACEMENT_PATIENCE
+    # Staged-queue depth beyond which an agent counts as busy: bulk shards
+    # defer and grants shrink by the excess.
+    busy_queue_depth: int = 2            # SCHED_BUSY_QUEUE_DEPTH
+    # Deadline escalation: once this fraction of deadline_sec has elapsed a
+    # still-pending job is bumped one priority tier (once).
+    escalate_frac: float = 0.75          # SCHED_ESCALATE_FRAC
+
+    @staticmethod
+    def from_env() -> "SchedConfig":
+        weights: Dict[str, float] = {}
+        for k, v in parse_labels(
+            os.environ.get("SCHED_TENANT_WEIGHTS", "")
+        ).items():
+            try:
+                weights[k] = float(v)
+            except (TypeError, ValueError):
+                pass
+        return SchedConfig(
+            policy=env_str("SCHED_POLICY", "fifo").strip().lower(),
+            default_priority=min(
+                9, max(0, env_int("SCHED_DEFAULT_PRIORITY", 4))
+            ),
+            max_pending=max(0, env_int("SCHED_MAX_PENDING", 0)),
+            max_pending_per_tenant=max(
+                0, env_int("SCHED_MAX_PENDING_PER_TENANT", 0)
+            ),
+            retry_after_ms=max(0, env_int("SCHED_RETRY_AFTER_MS", 1000)),
+            tenant_weights=weights,
+            placement_patience=max(0, env_int("SCHED_PLACEMENT_PATIENCE", 3)),
+            busy_queue_depth=max(0, env_int("SCHED_BUSY_QUEUE_DEPTH", 2)),
+            escalate_frac=min(
+                1.0, max(0.0, env_float("SCHED_ESCALATE_FRAC", 0.75))
+            ),
+        )
+
+
+@dataclass(frozen=True)
 class OpsConfig:
     """Per-op knobs (reference ``ops/map_summarize.py:9-10``, trigger envs)."""
 
@@ -288,6 +349,7 @@ class Config:
     device: DeviceConfig = field(default_factory=DeviceConfig)
     sizing: SizingConfig = field(default_factory=SizingConfig)
     ops: OpsConfig = field(default_factory=OpsConfig)
+    sched: SchedConfig = field(default_factory=SchedConfig)
 
     @staticmethod
     def from_env() -> "Config":
@@ -296,4 +358,5 @@ class Config:
             device=DeviceConfig.from_env(),
             sizing=SizingConfig.from_env(),
             ops=OpsConfig.from_env(),
+            sched=SchedConfig.from_env(),
         )

@@ -15,6 +15,18 @@ Design decisions:
   shard_size)`` into one task per shard addressed ``(start_row, shard_size)``
   — the reference's data-distribution primitive (ref ``ops/csv_shard.py:9-26``)
   — and an optional ``reduce_op`` job gated on the shards completing.
+- **Delegated scheduling** (ISSUE 4): every lease decision goes through a
+  pluggable ``sched.Scheduler``. The default ``fifo`` policy replays the
+  historical inline queue scan bit-for-bit; ``SCHED_POLICY=fair`` adds
+  priority tiers (0–9), weighted deficit-round-robin across tenants,
+  load/capability-aware placement (TPU-tagged ops prefer TPU agents, bulk
+  shards prefer idle agents, deep-queue agents get shrunken grants),
+  bounded admission (HTTP 429 + ``retry_after_ms`` past the pending
+  budget), and deadline handling (``deadline_sec`` expiry lands terminal
+  ``dead`` with a ``DeadlineExceeded`` reason; near-deadline pending jobs
+  escalate one priority tier). The controller keeps owning correctness
+  (state machine, fencing, labels, dependencies, journal); the policy owns
+  only order and placement.
 - **Fault injection** (SURVEY.md §5.3): ``inject(...)`` arms one-shot faults —
   ``drop_lease`` (issue no tasks once), ``duplicate_task`` (hand the same task
   to two leases), ``stale_epoch`` (bump a job's epoch right after leasing so
@@ -49,7 +61,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
-from agent_tpu.config import TRUTHY_TOKENS
+from agent_tpu.config import TRUTHY_TOKENS, SchedConfig
 from agent_tpu.obs.metrics import (
     MetricsRegistry,
     histogram_quantile,
@@ -57,6 +69,15 @@ from agent_tpu.obs.metrics import (
     render_snapshots,
 )
 from agent_tpu.obs.recorder import FlightRecorder
+from agent_tpu.sched import (
+    DEFAULT_PRIORITY,
+    DEFAULT_TENANT,
+    PRIORITY_MAX,
+    PRIORITY_MIN,
+    AdmissionError,
+    LeaseContext,
+    make_scheduler,
+)
 from agent_tpu.utils.logging import log
 from agent_tpu.utils.retry import PERMANENT, classify_error
 
@@ -119,6 +140,17 @@ class Job:
     # and non-True values must match (the consumer side of the AGENT_LABELS
     # channel the protocol has always carried, reference app.py:49-63,168).
     required_labels: Dict[str, Any] = field(default_factory=dict)
+    # Scheduling (ISSUE 4). priority 0–9 (9 = most urgent); tenant is the
+    # fair-share bucket; deadline_sec counts from submit (re-anchored to
+    # replay time after a restart — the journal carries no wall clock).
+    priority: int = DEFAULT_PRIORITY
+    tenant: str = DEFAULT_TENANT
+    deadline_sec: Optional[float] = None
+    # One-shot near-deadline escalation marker (sweeper bumps one tier).
+    escalated: bool = False
+    # Times the fair policy skipped this job waiting for a better-placed
+    # agent; capped by SCHED_PLACEMENT_PATIENCE so preference never starves.
+    placement_defers: int = 0
 
     def to_task(self) -> Dict[str, Any]:
         return {
@@ -144,14 +176,15 @@ class Controller:
         recorder: Optional[FlightRecorder] = None,
         max_attempts: int = DEFAULT_MAX_ATTEMPTS,
         requeue_delay_sec: float = 0.0,
+        sched: Optional[SchedConfig] = None,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
         self.max_attempts = max(1, int(max_attempts))
         self.requeue_delay_sec = max(0.0, float(requeue_delay_sec))
+        self.sched_config = sched if sched is not None else SchedConfig()
         self._clock = clock
         self._lock = threading.Lock()
         self._jobs: Dict[str, Job] = {}
-        self._queue: List[str] = []  # FIFO of pending job ids
         self._faults: List[str] = []  # one-shot armed faults
         self._fault_plan = None      # seeded probabilistic plan (chaos.py)
         self.stale_results = 0
@@ -201,8 +234,50 @@ class Controller:
         self._m_queue_wait = m.histogram(
             "controller_queue_wait_seconds",
             "submit -> first lease latency", ("op",))
+        # ISSUE 4 satellite: `state` separates jobs leasable right now from
+        # jobs held back by a requeue delay (`not_before`) — the old
+        # unlabeled gauge counted held jobs as leasable.
         self._m_queue_depth = m.gauge(
-            "controller_queue_depth", "Leasable (pending) jobs")
+            "controller_queue_depth",
+            "Queued (pending) jobs by leasability", ("state",))
+        self._m_journal_torn = m.counter(
+            "controller_journal_torn_tail_total",
+            "Journal replays that found a torn (unparseable) final line")
+        # Scheduler observability (ISSUE 4): decision counters, per-tenant
+        # queue depth, and how long jobs waited before their first lease
+        # (the starvation signal the fair policy exists to bound).
+        self._m_sched_decisions = m.counter(
+            "sched_decisions_total",
+            "Scheduler decisions (leased/deferred_placement/escalated/"
+            "deadline_dead/admission_rejected)", ("policy", "decision"))
+        self._m_sched_depth = m.gauge(
+            "sched_queue_depth", "Queued jobs per tenant", ("tenant",))
+        self._m_starvation = m.histogram(
+            "sched_starvation_age_seconds",
+            "Job age (since submit) at first lease, per tenant", ("tenant",))
+        self._m_admission = m.counter(
+            "controller_admission_rejections_total",
+            "Submits rejected by admission control (HTTP 429)", ("tenant",))
+        self._m_deadline_dead = m.counter(
+            "controller_jobs_deadline_expired_total",
+            "Pending jobs that ran out of deadline_sec (terminal `dead`, "
+            "reason DeadlineExceeded)", ("op",))
+        # The policy object every lease decision delegates to (ISSUE 4).
+        self._sched = make_scheduler(
+            self.sched_config,
+            on_decision=lambda decision: self._m_sched_decisions.inc(
+                policy=self.sched_config.policy, decision=decision
+            ),
+        )
+        # Queued job ids currently held back by a requeue delay — the small
+        # set scanned to split the depth gauge into leasable vs held.
+        self._delayed: Set[str] = set()
+        # Job ids carrying a deadline (non-terminal) — the sweeper's
+        # deadline/escalation scan iterates only these.
+        self._deadlined: Set[str] = set()
+        # Tenants that ever had a sched_queue_depth sample: drained tenants
+        # report 0 instead of a stale last value.
+        self._seen_tenants: Set[str] = set()
         # The most recent profile that actually carried a TPU sizing hint —
         # kept separately because in a mixed fleet every leasing agent
         # overwrites last_profile, and a CPU agent's poll must not revert
@@ -219,6 +294,35 @@ class Controller:
         self._sweep_stop = threading.Event()
         if sweep_interval_sec:
             self.start_sweeper(sweep_interval_sec)
+
+    @property
+    def _queue(self) -> List[str]:
+        """Queued job ids in dispatch order (legacy introspection surface —
+        the list the scheduler replaced; tests and debugging peek at it)."""
+        return self._sched.queued_ids()
+
+    def _update_queue_stats_locked(self, now: Optional[float] = None) -> None:
+        """Refresh the depth gauges: controller_queue_depth{state} splits
+        leasable from requeue-delay-held jobs; sched_queue_depth{tenant} is
+        the per-tenant fair-share view. Only jobs that ever received a
+        requeue delay are scanned (the ``_delayed`` set), so the hot submit
+        path stays O(1) in queue length."""
+        if now is None:
+            now = self._clock()
+        total = self._sched.total()
+        held = 0
+        for jid in list(self._delayed):
+            job = self._jobs.get(jid)
+            if job is None or job.state != PENDING or job.not_before <= now:
+                self._delayed.discard(jid)
+            else:
+                held += 1
+        self._m_queue_depth.set(total - held, state="leasable")
+        self._m_queue_depth.set(held, state="held")
+        depths = self._sched.depth_by_tenant()
+        self._seen_tenants.update(depths)
+        for tenant in self._seen_tenants:
+            self._m_sched_depth.set(depths.get(tenant, 0), tenant=tenant)
 
     # ---- durability (journal) ----
 
@@ -249,7 +353,16 @@ class Controller:
                 ev = json.loads(line)
             except ValueError:
                 if i == len(lines) - 1:
-                    continue  # torn FINAL write from a crash — expected
+                    # Torn FINAL write from a crash — an expected failure
+                    # mode, tolerated; but no longer silently (ISSUE 4
+                    # satellite): a counted warning distinguishes "the
+                    # controller died mid-append" from a pristine journal.
+                    self._m_journal_torn.inc()
+                    log(
+                        "journal replay tolerated a torn final line",
+                        path=path, line=i + 1,
+                    )
+                    continue
                 # Mid-file corruption is NOT a torn write: something else
                 # damaged the journal. Skipping silently would quietly
                 # resurrect or lose jobs, so count + warn (ISSUE 3 satellite).
@@ -258,6 +371,7 @@ class Controller:
             if ev.get("ev") == "submit":
                 after_order = tuple(ev.get("after") or ())
                 raw_max = ev.get("max_attempts")
+                raw_deadline = ev.get("deadline_sec")
                 self._jobs[ev["job_id"]] = Job(
                     job_id=ev["job_id"],
                     op=ev["op"],
@@ -266,6 +380,15 @@ class Controller:
                     after_order=after_order,
                     required_labels=ev.get("required_labels") or {},
                     max_attempts=int(raw_max) if raw_max else None,
+                    # Journal schema vN+1 (ISSUE 4): scheduling fields ride
+                    # the submit record only when the submitter set them, so
+                    # old journals (and default submissions) replay — and
+                    # re-journal — byte-identically.
+                    priority=int(
+                        ev.get("priority", self.sched_config.default_priority)
+                    ),
+                    tenant=str(ev.get("tenant", DEFAULT_TENANT)),
+                    deadline_sec=float(raw_deadline) if raw_deadline else None,
                 )
                 self._depended_on.update(after_order)
             elif ev.get("ev") == "result":
@@ -300,20 +423,31 @@ class Controller:
         # and is accepted; if the job was meanwhile re-leased and completed
         # by someone else, the terminal-state guard rejects the second
         # application (first wins) — never applied twice either way.
+        now = self._clock()
         for job in self._jobs.values():
             if job.state not in TERMINAL_STATES:
                 job.state = PENDING
                 job.lease_id = None
-        self._queue = [
-            j.job_id for j in self._jobs.values() if j.state == PENDING
-        ]
+                # Deadlines re-anchor to replay time (the journal carries no
+                # wall clock); queue-wait attribution restarts here too.
+                job.submitted_at = now
+                self._sched.add(job)
+                if job.deadline_sec is not None:
+                    self._deadlined.add(job.job_id)
+        self._update_queue_stats_locked(now)
 
     # ---- liveness (background TTL sweeper) ----
 
     def sweep(self) -> None:
-        """Re-queue expired leases now (also runs inside every ``lease()``)."""
+        """Re-queue expired leases and enforce deadlines now (both also run
+        inside every ``lease()``)."""
         with self._lock:
             self._expire_leases_locked()
+            self._expire_deadlines_locked()
+            # Held → leasable is a time-passive transition (not_before
+            # elapsing): the sweep is what keeps the split gauge truthful
+            # with no lease traffic.
+            self._update_queue_stats_locked()
 
     def start_sweeper(self, interval_sec: float = 5.0) -> None:
         """TTL enforcement without traffic: a daemon thread sweeping every
@@ -345,6 +479,45 @@ class Controller:
 
     # ---- job submission ----
 
+    def _admit_locked(self, tenant: str, n: int = 1) -> None:
+        """Admission control (ISSUE 4): raise ``AdmissionError`` (wire: 429
+        + retry_after_ms) when accepting ``n`` more jobs would breach the
+        global or per-tenant pending budget. Budgets of 0 = unbounded, so
+        the default configuration admits everything (fifo bit-compat)."""
+        cfg = self.sched_config
+        if cfg.max_pending and self._sched.total() + n > cfg.max_pending:
+            self._m_admission.inc(tenant=tenant)
+            self.recorder.record(
+                "admission_rejected", tenant=tenant, scope="global",
+                pending=self._sched.total(), budget=cfg.max_pending,
+            )
+            self._m_sched_decisions.inc(
+                policy=cfg.policy, decision="admission_rejected")
+            raise AdmissionError(
+                f"pending budget exhausted ({self._sched.total()} queued, "
+                f"global budget {cfg.max_pending})",
+                retry_after_ms=cfg.retry_after_ms, tenant=tenant,
+                scope="global",
+            )
+        if cfg.max_pending_per_tenant and (
+            self._sched.depth_for(tenant) + n > cfg.max_pending_per_tenant
+        ):
+            self._m_admission.inc(tenant=tenant)
+            self.recorder.record(
+                "admission_rejected", tenant=tenant, scope="tenant",
+                pending=self._sched.depth_for(tenant),
+                budget=cfg.max_pending_per_tenant,
+            )
+            self._m_sched_decisions.inc(
+                policy=cfg.policy, decision="admission_rejected")
+            raise AdmissionError(
+                f"tenant {tenant!r} pending budget exhausted "
+                f"({self._sched.depth_for(tenant)} queued, budget "
+                f"{cfg.max_pending_per_tenant})",
+                retry_after_ms=cfg.retry_after_ms, tenant=tenant,
+                scope="tenant",
+            )
+
     def submit(
         self,
         op: str,
@@ -353,8 +526,35 @@ class Controller:
         after: Optional[Sequence[str]] = None,
         required_labels: Optional[Dict[str, Any]] = None,
         max_attempts: Optional[int] = None,
+        priority: Optional[int] = None,
+        tenant: Optional[str] = None,
+        deadline_sec: Optional[float] = None,
     ) -> str:
         job_id = job_id or f"job-{uuid.uuid4().hex[:12]}"
+        if priority is not None:
+            if (
+                isinstance(priority, bool)
+                or not isinstance(priority, int)
+                or not PRIORITY_MIN <= priority <= PRIORITY_MAX
+            ):
+                raise ValueError(
+                    f"priority must be an int in "
+                    f"[{PRIORITY_MIN}, {PRIORITY_MAX}], got {priority!r}"
+                )
+        if tenant is not None and (
+            not isinstance(tenant, str) or not tenant
+        ):
+            raise ValueError(f"tenant must be a non-empty string, got {tenant!r}")
+        if deadline_sec is not None:
+            if (
+                isinstance(deadline_sec, bool)
+                or not isinstance(deadline_sec, (int, float))
+                or deadline_sec <= 0
+            ):
+                raise ValueError(
+                    f"deadline_sec must be a positive number, got "
+                    f"{deadline_sec!r}"
+                )
         if max_attempts is not None:
             if (
                 isinstance(max_attempts, bool)
@@ -396,27 +596,48 @@ class Controller:
             after_order=after_order,
             required_labels=required_labels,
             max_attempts=max_attempts,
+            priority=(
+                priority if priority is not None
+                else self.sched_config.default_priority
+            ),
+            tenant=tenant if tenant is not None else DEFAULT_TENANT,
+            deadline_sec=(
+                float(deadline_sec) if deadline_sec is not None else None
+            ),
         )
         with self._lock:
             if job_id in self._jobs:
                 raise ValueError(f"duplicate job id {job_id!r}")
-            job.submitted_at = self._clock()
+            self._admit_locked(job.tenant)
+            now = self._clock()
+            job.submitted_at = now
             self._jobs[job_id] = job
-            self._queue.append(job_id)
-            self._m_queue_depth.set(len(self._queue))
+            self._sched.add(job)
+            if job.deadline_sec is not None:
+                self._deadlined.add(job_id)
+            self._update_queue_stats_locked(now)
             self.recorder.record("submit", job_id=job_id, op=op)
             self._depended_on.update(after_order)
-            self._journal(
-                {
-                    "ev": "submit",
-                    "job_id": job_id,
-                    "op": op,
-                    "payload": job.payload,
-                    "after": list(after_order),
-                    "required_labels": required_labels,
-                    "max_attempts": max_attempts,
-                }
-            )
+            # Journal schema vN+1: the scheduling fields are appended only
+            # when the caller set them, so default submissions keep writing
+            # the exact bytes the pre-scheduler controller wrote (the fifo
+            # byte-compat guarantee) and old journals replay unchanged.
+            record = {
+                "ev": "submit",
+                "job_id": job_id,
+                "op": op,
+                "payload": job.payload,
+                "after": list(after_order),
+                "required_labels": required_labels,
+                "max_attempts": max_attempts,
+            }
+            if priority is not None:
+                record["priority"] = job.priority
+            if tenant is not None:
+                record["tenant"] = job.tenant
+            if deadline_sec is not None:
+                record["deadline_sec"] = job.deadline_sec
+            self._journal(record)
         return job_id
 
     def suggested_shard_size(self) -> Optional[int]:
@@ -445,6 +666,9 @@ class Controller:
         required_labels: Optional[Dict[str, Any]] = None,
         collect_partials: bool = False,
         max_attempts: Optional[int] = None,
+        priority: Optional[int] = None,
+        tenant: Optional[str] = None,
+        deadline_sec: Optional[float] = None,
     ) -> Tuple[List[str], Optional[str]]:
         """Split a CSV dataset into shard tasks (+ optional gated reduce job).
 
@@ -471,6 +695,13 @@ class Controller:
             # Zero shards + an immediately-leasable reduce-over-nothing is
             # never what the caller meant.
             raise ValueError("total_rows must be positive")
+        # Whole-batch admission pre-check: reject before the first shard
+        # submits rather than 429-ing mid-split and leaving a half-submitted
+        # job behind. (Advisory — each submit re-checks under the lock.)
+        n_jobs = -(-total_rows // shard_size) + (1 if reduce_op else 0)
+        with self._lock:
+            self._admit_locked(tenant if tenant is not None else DEFAULT_TENANT,
+                               n_jobs)
         shard_ids: List[str] = []
         for i, start in enumerate(range(0, total_rows, shard_size)):
             payload = dict(extra_payload or {})
@@ -486,6 +717,9 @@ class Controller:
                     job_id=f"shard-{i}-{uuid.uuid4().hex[:8]}",
                     required_labels=required_labels,
                     max_attempts=max_attempts,
+                    priority=priority,
+                    tenant=tenant,
+                    deadline_sec=deadline_sec,
                 )
             )
         reduce_id = None
@@ -499,6 +733,9 @@ class Controller:
                 after=shard_ids,  # ordered: partials materialize shard-order
                 required_labels=required_labels,
                 max_attempts=max_attempts,
+                priority=priority,
+                tenant=tenant,
+                deadline_sec=deadline_sec,
             )
         return shard_ids, reduce_id
 
@@ -536,15 +773,81 @@ class Controller:
                 job.epoch += 1
                 job.state = PENDING
                 job.lease_id = None
-                self._queue.append(job.job_id)
+                self._sched.add(job)
                 self._m_expirations.inc(op=job.op)
-                self._m_queue_depth.set(len(self._queue))
+                self._update_queue_stats_locked(now)
                 self.recorder.record(
                     "lease_expired", job_id=job.job_id, op=job.op,
                     epoch=job.epoch, agent=job.agent,
                 )
                 self._journal(
                     {"ev": "requeue", "job_id": job.job_id, "epoch": job.epoch}
+                )
+
+    def _expire_deadlines_locked(self) -> None:
+        """Deadline/TTL enforcement (ISSUE 4): a PENDING job whose
+        ``deadline_sec`` elapsed lands the existing terminal ``dead`` state
+        with a distinct ``DeadlineExceeded`` reason; a still-pending job
+        past ``SCHED_ESCALATE_FRAC`` of its deadline escalates one priority
+        tier (once). Leased jobs are left alone — an in-flight attempt may
+        still beat the deadline, and its result is accepted if it does."""
+        if not self._deadlined:
+            return
+        now = self._clock()
+        frac = self.sched_config.escalate_frac
+        for jid in list(self._deadlined):
+            job = self._jobs.get(jid)
+            if job is None or job.state in TERMINAL_STATES \
+                    or job.deadline_sec is None:
+                self._deadlined.discard(jid)
+                continue
+            age = now - job.submitted_at
+            if job.state != PENDING:
+                continue  # leased: give the in-flight attempt its chance
+            if age >= job.deadline_sec:
+                self._sched.discard(jid)
+                self._delayed.discard(jid)
+                self._deadlined.discard(jid)
+                job.error = {
+                    "type": "DeadlineExceeded",
+                    "message": (
+                        f"deadline_sec {job.deadline_sec} elapsed after "
+                        f"{job.attempts} attempt(s)"
+                    ),
+                    "trace": "",
+                }
+                job.state = DEAD
+                self._m_dead.inc(op=job.op)
+                self._m_deadline_dead.inc(op=job.op)
+                self._m_sched_decisions.inc(
+                    policy=self.sched_config.policy, decision="deadline_dead")
+                self.recorder.record(
+                    "dead", job_id=jid, op=job.op, reason="deadline",
+                    deadline_sec=job.deadline_sec, attempts=job.attempts,
+                )
+                self._update_queue_stats_locked(now)
+                # Journaled as a result record so replay keeps it dead.
+                self._journal(
+                    {
+                        "ev": "result",
+                        "job_id": jid,
+                        "state": DEAD,
+                        "epoch": job.epoch,
+                        "attempts": job.attempts,
+                        "result": None,
+                        "error": job.error,
+                    }
+                )
+            elif not job.escalated and age >= job.deadline_sec * frac:
+                job.escalated = True
+                if job.priority < PRIORITY_MAX:
+                    job.priority += 1
+                    self._sched.reprioritize(job)
+                self._m_sched_decisions.inc(
+                    policy=self.sched_config.policy, decision="escalated")
+                self.recorder.record(
+                    "deadline_escalated", job_id=jid, op=job.op,
+                    priority=job.priority,
                 )
 
     def _deps_done_locked(self, job: Job) -> bool:
@@ -605,8 +908,16 @@ class Controller:
         flush channel drain loops use to push their final counters after the
         last task posts (old agents always send ≥ 1, so the wire contract
         is unchanged for them).
+
+        Which jobs go out — and how many — is the scheduler's call
+        (ISSUE 4): this method owns eligibility (state, not_before,
+        capability ops, labels, dependencies) and the lease bookkeeping;
+        ``self._sched.take`` owns order and placement, fed the enriched
+        capability fields (``device_kind``, ``mesh_devices``,
+        ``queue_depth``) agents now advertise.
         """
-        ops = set((capabilities or {}).get("ops") or [])
+        caps = capabilities or {}
+        ops = set(caps.get("ops") or [])
         labels = labels or {}
         with self._lock:
             now_wall = time.time()
@@ -628,6 +939,7 @@ class Controller:
                 if isinstance(tpu, dict) and tpu.get("suggested_shard_rows"):
                     self._last_tpu_profile = worker_profile
             self._expire_leases_locked()
+            self._expire_deadlines_locked()
             if max_tasks < 1:
                 self._m_lease.inc(outcome="metrics_only")
                 return None
@@ -650,69 +962,87 @@ class Controller:
             now = self._clock()
             deadline = now + self.lease_ttl_sec
             tasks: List[Dict[str, Any]] = []
-            remaining: List[str] = []
-            for job_id in self._queue:
-                job = self._jobs[job_id]
-                if (
-                    len(tasks) < max(1, max_tasks)
-                    and job.state == PENDING
+            # Grant accounting: the historical loop bounded len(tasks) —
+            # which included the duplicate_task copy — so an armed duplicate
+            # consumes one distinct-job slot (unless only one slot exists).
+            n = max(1, max_tasks)
+            limit = max(1, n - 1) if duplicate else n
+
+            def eligible(job: Job) -> bool:
+                return (
+                    job.state == PENDING
                     and job.not_before <= now
                     and (not ops or job.op in ops)
                     and self._labels_match(job, labels)
                     and self._deps_done_locked(job)
-                ):
-                    job.state = LEASED
-                    job.lease_id = lease_id
-                    job.lease_deadline = deadline
-                    job.agent = agent
-                    job.attempts += 1
-                    self._m_tasks_leased.inc(op=job.op)
-                    if job.attempts == 1:
-                        # Queue-wait attribution: submit → FIRST lease only
-                        # (a retry's wait measures failure handling, not
-                        # scheduling pressure).
-                        self._m_queue_wait.observe(
-                            max(0.0, now - job.submitted_at), op=job.op
-                        )
-                    self.recorder.record(
-                        "lease", job_id=job.job_id, op=job.op,
-                        lease_id=lease_id, agent=agent, epoch=job.epoch,
-                        attempt=job.attempts,
+                )
+
+            ctx = LeaseContext(
+                agent=agent,
+                now=now,
+                limit=limit,
+                requested=n,
+                ops=frozenset(ops),
+                labels=labels,
+                device_kind=caps.get("device_kind"),
+                mesh_devices=caps.get("mesh_devices"),
+                queue_depth=caps.get("queue_depth"),
+            )
+            for job in self._sched.take(ctx, eligible):
+                job.state = LEASED
+                job.lease_id = lease_id
+                job.lease_deadline = deadline
+                job.agent = agent
+                job.attempts += 1
+                self._m_tasks_leased.inc(op=job.op)
+                self._m_sched_decisions.inc(
+                    policy=self.sched_config.policy, decision="leased")
+                if job.attempts == 1:
+                    # Queue-wait attribution: submit → FIRST lease only
+                    # (a retry's wait measures failure handling, not
+                    # scheduling pressure).
+                    self._m_queue_wait.observe(
+                        max(0.0, now - job.submitted_at), op=job.op
                     )
-                    if job.payload.pop("__collect_partials__", None):
-                        # Reduce-time materialization: dependency results
-                        # become the op's partials (kept out of the payload
-                        # until every shard result actually exists), in
-                        # submission order — shard order, for reduce ops
-                        # that are order-sensitive.
-                        job.payload["partials"] = [
-                            self._jobs[d].result
-                            for d in job.after_order
-                            if d in self._jobs
-                        ]
+                    self._m_starvation.observe(
+                        max(0.0, now - job.submitted_at), tenant=job.tenant
+                    )
+                self.recorder.record(
+                    "lease", job_id=job.job_id, op=job.op,
+                    lease_id=lease_id, agent=agent, epoch=job.epoch,
+                    attempt=job.attempts,
+                )
+                if job.payload.pop("__collect_partials__", None):
+                    # Reduce-time materialization: dependency results
+                    # become the op's partials (kept out of the payload
+                    # until every shard result actually exists), in
+                    # submission order — shard order, for reduce ops
+                    # that are order-sensitive.
+                    job.payload["partials"] = [
+                        self._jobs[d].result
+                        for d in job.after_order
+                        if d in self._jobs
+                    ]
+                tasks.append(job.to_task())
+                if duplicate:
+                    # Same task handed out twice under one lease: the
+                    # second completion must be idempotent/fenced.
                     tasks.append(job.to_task())
-                    if duplicate:
-                        # Same task handed out twice under one lease: the
-                        # second completion must be idempotent/fenced.
-                        tasks.append(job.to_task())
-                        duplicate = False
-                        self._m_faults.inc(fault="duplicate_task")
-                        self.recorder.record(
-                            "fault", fault="duplicate_task", job_id=job.job_id
-                        )
-                    if stale:
-                        # Epoch bumps right after leasing → the agent's result
-                        # arrives carrying the old epoch and is discarded.
-                        job.epoch += 1
-                        stale = False
-                        self._m_faults.inc(fault="stale_epoch")
-                        self.recorder.record(
-                            "fault", fault="stale_epoch", job_id=job.job_id
-                        )
-                else:
-                    remaining.append(job_id)
-            self._queue = remaining
-            self._m_queue_depth.set(len(self._queue))
+                    duplicate = False
+                    self._m_faults.inc(fault="duplicate_task")
+                    self.recorder.record(
+                        "fault", fault="duplicate_task", job_id=job.job_id
+                    )
+                if stale:
+                    # Epoch bumps right after leasing → the agent's result
+                    # arrives carrying the old epoch and is discarded.
+                    job.epoch += 1
+                    stale = False
+                    self._m_faults.inc(fault="stale_epoch")
+                    self.recorder.record(
+                        "fault", fault="stale_epoch", job_id=job.job_id
+                    )
+            self._update_queue_stats_locked(now)
             if not tasks:
                 self._m_lease.inc(outcome="idle")
                 return None
@@ -795,9 +1125,12 @@ class Controller:
                     job.state = PENDING
                     job.epoch += 1
                     job.not_before = self._clock() + self.requeue_delay_sec
-                    self._queue.append(job.job_id)
+                    self._sched.add(job)
+                    if self.requeue_delay_sec > 0:
+                        # Feeds the held/leasable split of the depth gauge.
+                        self._delayed.add(job.job_id)
                     self._m_retries.inc(op=job.op)
-                    self._m_queue_depth.set(len(self._queue))
+                    self._update_queue_stats_locked()
                     self.recorder.record(
                         "retry", job_id=job_id, op=job.op, epoch=job.epoch,
                         attempt=job.attempts, budget=budget,
@@ -852,6 +1185,9 @@ class Controller:
                 "agent": job.agent,
                 "result": job.result,
                 "error": job.error,
+                "priority": job.priority,
+                "tenant": job.tenant,
+                "deadline_sec": job.deadline_sec,
             }
 
     def counts(self) -> Dict[str, int]:
@@ -888,7 +1224,7 @@ class Controller:
 
     def queue_depth(self) -> int:
         with self._lock:
-            return len(self._queue)
+            return self._sched.total()
 
     def agents_summary(self) -> Dict[str, Any]:
         """Per-agent liveness: seconds since the last lease poll plus the

@@ -15,6 +15,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 from agent_tpu.controller.core import Controller
+from agent_tpu.sched import AdmissionError
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -98,6 +99,23 @@ class _Handler(BaseHTTPRequestHandler):
                     if body.get("max_attempts") is not None
                     else None
                 )
+                # Scheduling fields (ISSUE 4): absent → controller defaults
+                # (SCHED_DEFAULT_PRIORITY, tenant "default", no deadline).
+                priority = (
+                    int(body["priority"])
+                    if body.get("priority") is not None
+                    else None
+                )
+                tenant = (
+                    str(body["tenant"])
+                    if body.get("tenant") is not None
+                    else None
+                )
+                deadline_sec = (
+                    float(body["deadline_sec"])
+                    if body.get("deadline_sec") is not None
+                    else None
+                )
                 if "source_uri" in body:
                     shard_ids, reduce_id = self.controller.submit_csv_job(
                         source_uri=str(body["source_uri"]),
@@ -115,6 +133,9 @@ class _Handler(BaseHTTPRequestHandler):
                         required_labels=body.get("required_labels"),
                         collect_partials=bool(body.get("collect_partials")),
                         max_attempts=max_attempts,
+                        priority=priority,
+                        tenant=tenant,
+                        deadline_sec=deadline_sec,
                     )
                     self._send(200, {"job_ids": shard_ids, "reduce_id": reduce_id})
                 else:
@@ -123,8 +144,30 @@ class _Handler(BaseHTTPRequestHandler):
                         payload=body.get("payload"),
                         required_labels=body.get("required_labels"),
                         max_attempts=max_attempts,
+                        priority=priority,
+                        tenant=tenant,
+                        deadline_sec=deadline_sec,
                     )
                     self._send(200, {"job_id": job_id})
+            except AdmissionError as exc:
+                # Backpressure, not failure: 429 + retry_after_ms is the
+                # admission-control contract — classify_http already calls
+                # 429 transient, so an unmodified RetryPolicy backs off.
+                self.send_response(429)
+                data = json.dumps({
+                    "error": str(exc),
+                    "retry_after_ms": exc.retry_after_ms,
+                    "tenant": exc.tenant,
+                    "scope": exc.scope,
+                }).encode()
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header(
+                    "Retry-After",
+                    str(max(1, (exc.retry_after_ms + 999) // 1000)),
+                )
+                self.end_headers()
+                self.wfile.write(data)
             except (KeyError, ValueError, TypeError) as exc:
                 self._send(400, {"error": str(exc)})
         else:
@@ -226,29 +269,37 @@ def main() -> int:
     agent_tpu.controller.server``. Env: CONTROLLER_HOST (default 0.0.0.0),
     CONTROLLER_PORT (default 8080), LEASE_TTL_SEC (default 30),
     MAX_ATTEMPTS (default retry budget, 2), REQUEUE_DELAY_SEC (retried jobs
-    held back this long, default 1)."""
+    held back this long, default 1), plus the SCHED_* scheduler knobs
+    (SCHED_POLICY fifo|fair, SCHED_MAX_PENDING[_PER_TENANT],
+    SCHED_TENANT_WEIGHTS, … — see config.SchedConfig)."""
     import signal
 
-    from agent_tpu.config import env_float, env_int, env_str
+    from agent_tpu.config import SchedConfig, env_float, env_int, env_str
 
     host = env_str("CONTROLLER_HOST", "0.0.0.0")
     port = env_int("CONTROLLER_PORT", 8080)
     ttl = env_float("LEASE_TTL_SEC", 30.0)
     journal = env_str("CONTROLLER_JOURNAL", "") or None
     sweep = env_float("CONTROLLER_SWEEP_SEC", 5.0)
+    sched = SchedConfig.from_env()
     controller = Controller(
         lease_ttl_sec=ttl,
         journal_path=journal,
         sweep_interval_sec=sweep if sweep > 0 else None,
         max_attempts=max(1, env_int("MAX_ATTEMPTS", 2)),
         requeue_delay_sec=env_float("REQUEUE_DELAY_SEC", 1.0),
+        sched=sched,
     )
     server = ControllerServer(controller, host=host, port=port)
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     server.start()
-    print(f"[agent-tpu-controller] serving on {server.url}", flush=True)
+    print(
+        f"[agent-tpu-controller] serving on {server.url} "
+        f"(sched policy={sched.policy})",
+        flush=True,
+    )
     stop.wait()
     server.stop()
     controller.close()

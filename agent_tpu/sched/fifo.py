@@ -1,0 +1,62 @@
+"""FIFO policy — bit-compatible with the pre-scheduler controller.
+
+This is the exact inline scan ``Controller.lease`` used to run over its
+``self._queue: List[str]``: walk the queue in arrival order, take eligible
+jobs until the grant limit, and leave every other job in its original
+relative position. Priority, tenant, and the agent's load advertisement are
+deliberately ignored — ``SCHED_POLICY=fifo`` must produce the same drain
+order (and therefore the same journal bytes) as HEAD for any interleaving
+of submit/lease/report/expire, which ``tests/test_sched.py`` pins with a
+model-based property test.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from agent_tpu.sched.base import LeaseContext, Scheduler
+
+
+class FifoScheduler(Scheduler):
+    name = "fifo"
+
+    def __init__(self, on_decision: Optional[Callable[[str], None]] = None
+                 ) -> None:
+        super().__init__(on_decision=on_decision)
+        self._order: List[Any] = []  # Job refs in arrival order
+
+    def add(self, job: Any) -> None:
+        self._order.append(job)
+        self._note_add(job)
+
+    def discard(self, job_id: str) -> bool:
+        for i, job in enumerate(self._order):
+            if job.job_id == job_id:
+                del self._order[i]
+                self._note_remove(job)
+                return True
+        return False
+
+    def reprioritize(self, job: Any) -> None:
+        # Priority has no queue effect under FIFO: escalation updates the
+        # job's field (visible in snapshots) but must not reorder anything.
+        pass
+
+    def take(
+        self, ctx: LeaseContext, eligible: Callable[[Any], bool]
+    ) -> List[Any]:
+        # The historical scan, verbatim: one pass, eligibility checked in
+        # queue order, ineligible and over-limit jobs keep their positions.
+        taken: List[Any] = []
+        remaining: List[Any] = []
+        for job in self._order:
+            if len(taken) < ctx.limit and eligible(job):
+                taken.append(job)
+                self._note_remove(job)
+            else:
+                remaining.append(job)
+        self._order = remaining
+        return taken
+
+    def queued_ids(self) -> List[str]:
+        return [job.job_id for job in self._order]

@@ -40,7 +40,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from agent_tpu.agent.app import Agent
 from agent_tpu.chaos import ChaosSession, FaultPlan, GatedSession, LoopbackSession
-from agent_tpu.config import AgentConfig, Config
+from agent_tpu.config import AgentConfig, Config, SchedConfig
 from agent_tpu.controller.core import TERMINAL_STATES, Controller
 from agent_tpu.obs.metrics import MetricsRegistry
 
@@ -311,6 +311,143 @@ def run_chaos(
     return problems
 
 
+def run_fair(
+    seed: int, csv_path: str, shards: int, rows_per_shard: int,
+    fault_rate: float, n_agents: int, tenants: int, deadline_sec: float,
+    reference: str,
+) -> List[str]:
+    """Fair-policy soak (ISSUE 4): a bulk tenant's sharded map-reduce
+    drains alongside other tenants' priority-8 interactive singles under
+    the same seeded fault plan. Asserts the fifo-chaos invariants (terminal
+    states, bit-identical reduce, single application) PLUS the fairness
+    bar: no tenant starves (zero ``dead``), every priority-8 single is
+    first-leased before ≥90% of bulk shards, and the per-tenant
+    ``sched_queue_depth`` / starvation-age series exist. The seeded plan +
+    deterministic scheduler make the whole drain replayable."""
+    problems: List[str] = []
+    plan = FaultPlan(
+        seed=seed,
+        drop_request=fault_rate * 0.5,
+        drop_response=fault_rate * 0.25,
+        http_500=fault_rate * 0.25,
+        duplicate_result=0.10,
+        drop_lease=0.10,
+        duplicate_task=0.05,
+        stale_epoch=0.05,
+    )
+    controller = Controller(
+        lease_ttl_sec=0.5, max_attempts=10, requeue_delay_sec=0.01,
+        sweep_interval_sec=0.1, sched=SchedConfig(policy="fair"),
+    )
+    controller.inject(plan=plan)
+    shard_ids, reduce_id = controller.submit_csv_job(
+        csv_path,
+        total_rows=shards * rows_per_shard,
+        shard_size=rows_per_shard,
+        map_op="risk_accumulate",
+        extra_payload={"field": "risk"},
+        reduce_op="risk_accumulate",
+        collect_partials=True,
+        tenant="bulk",
+    )
+    single_ids: List[str] = []
+    for t in range(1, max(2, tenants)):
+        for k in range(4):
+            single_ids.append(controller.submit(
+                "risk_accumulate",
+                {
+                    "source_uri": csv_path,
+                    "start_row": (k % shards) * rows_per_shard,
+                    "shard_size": rows_per_shard,
+                    "field": "risk",
+                },
+                tenant=f"rt{t}",
+                priority=8,
+            ))
+    agents = [
+        make_agent(controller, f"fair-{seed}-{i}", plan=plan)
+        for i in range(n_agents)
+    ]
+    try:
+        agents, _, drained = drive_drain(
+            controller, agents, plan, deadline_sec
+        )
+    finally:
+        controller.close()
+
+    n_jobs = shards + 1 + len(single_ids)
+    if not drained:
+        return [
+            f"seed {seed}: fair drain did not reach terminal states "
+            f"(counts {controller.counts()})"
+        ]
+    counts = controller.counts()
+    if counts.get("dead"):
+        problems.append(
+            f"seed {seed}: {counts['dead']} dead job(s) under fair policy "
+            "(starvation or retry exhaustion)"
+        )
+    reduce_job = controller.job_snapshot(reduce_id)
+    if reduce_job["state"] != "succeeded":
+        problems.append(
+            f"seed {seed}: fair reduce state {reduce_job['state']!r}"
+        )
+        return problems
+    got = canonical(reduce_job["result"])
+    if got != reference:
+        problems.append(
+            f"seed {seed}: fair reduce diverged from fault-free reference\n"
+            f"  want {reference}\n  got  {got}"
+        )
+    accepted = counter_total(
+        controller.metrics, "controller_results_total", outcome="succeeded"
+    )
+    if accepted != n_jobs:
+        problems.append(
+            f"seed {seed}: accepted successes {accepted} != jobs {n_jobs}"
+        )
+
+    # Fairness: first-lease order from the flight recorder — every
+    # priority-8 single must beat ≥90% of bulk shards to its first lease.
+    first_lease: Dict[str, int] = {}
+    for ev in controller.recorder.events():
+        if ev.get("kind") == "lease" and ev.get("job_id") not in first_lease:
+            first_lease[ev["job_id"]] = len(first_lease)
+    missing = [j for j in single_ids + shard_ids if j not in first_lease]
+    if missing:
+        problems.append(f"seed {seed}: jobs never leased: {missing[:5]}")
+    else:
+        bulk_pos = sorted(first_lease[j] for j in shard_ids)
+        p90_bulk = bulk_pos[int(0.9 * (len(bulk_pos) - 1))]
+        late = [j for j in single_ids if first_lease[j] > p90_bulk]
+        if late:
+            problems.append(
+                f"seed {seed}: {len(late)} priority-8 single(s) first-leased "
+                f"after the 90th-percentile bulk shard (fair-share failed)"
+            )
+    snap = controller.metrics.snapshot()
+    tenants_seen = {
+        s["labels"].get("tenant")
+        for s in snap.get("sched_queue_depth", {}).get("series", [])
+    }
+    want_tenants = {"bulk"} | {f"rt{t}" for t in range(1, max(2, tenants))}
+    if not want_tenants <= tenants_seen:
+        problems.append(
+            f"seed {seed}: sched_queue_depth missing tenants "
+            f"{sorted(want_tenants - tenants_seen)}"
+        )
+    if not snap.get("sched_starvation_age_seconds", {}).get("series"):
+        problems.append(f"seed {seed}: no starvation-age observations")
+
+    print(json.dumps({
+        "scenario": "fair", "seed": seed, "shards": shards,
+        "tenants": sorted(want_tenants), "jobs": n_jobs,
+        "faults_injected": dict(sorted(plan.counts.items())),
+        "counts": counts, "ok": not problems,
+    }, sort_keys=True))
+    return problems
+
+
 def run_outage(seed: int, csv_path: str, shards: int, rows_per_shard: int,
                deadline_sec: float) -> List[str]:
     """Controller 'outage' shorter than the lease TTL: completed results
@@ -400,6 +537,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="per-scenario wall-clock budget")
     ap.add_argument("--quick", action="store_true",
                     help="CI sizing: caps shards/rows/deadline for <1 min")
+    ap.add_argument("--policy", choices=("fifo", "fair"), default="fifo",
+                    help="scheduler policy under chaos (ISSUE 4); `fair` "
+                         "adds multi-tenant fairness assertions")
+    ap.add_argument("--tenants", type=int, default=3,
+                    help="tenant count for --policy fair (1 bulk + N-1 "
+                         "interactive)")
     args = ap.parse_args(argv)
 
     shards = args.shards
@@ -424,11 +567,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         problems += ref_problems
         if not ref_problems:
             for seed in seeds:
-                problems += run_chaos(
-                    seed, csv_path, shards, rows, args.fault_rate,
-                    args.agents, deadline, reference,
-                )
-                problems += run_outage(seed, csv_path, shards, rows, deadline)
+                if args.policy == "fair":
+                    problems += run_fair(
+                        seed, csv_path, shards, rows, args.fault_rate,
+                        args.agents, args.tenants, deadline, reference,
+                    )
+                else:
+                    problems += run_chaos(
+                        seed, csv_path, shards, rows, args.fault_rate,
+                        args.agents, deadline, reference,
+                    )
+                    problems += run_outage(
+                        seed, csv_path, shards, rows, deadline
+                    )
 
     elapsed = round(time.monotonic() - t0, 3)
     if problems:

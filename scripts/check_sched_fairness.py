@@ -1,0 +1,195 @@
+#!/usr/bin/env python3
+"""Scheduler fairness smoke (ISSUE 4) — the CI gate next to the metrics
+smoke.
+
+Two tenants share a fair-policy controller, driven by the real ``Agent``
+loop over ``chaos.LoopbackSession`` (in-process, deterministic, no jax):
+
+- tenant ``bulk`` submits one 64-shard CSV drain at the default priority —
+  the traffic class that starves everything behind it under plain FIFO;
+- tenant ``rt`` submits a handful of priority-9 singles at the same time.
+
+Asserts:
+
+1. **Priority wins**: every priority-9 job is first-leased before ≥90% of
+   the bulk shards (the acceptance bar), and completes first.
+2. **No starvation**: both tenants fully drain; zero ``dead`` jobs; the
+   per-tenant ``sched_queue_depth`` gauges and the starvation-age
+   histogram are present in the controller registry.
+3. **Admission backpressure**: with a pending budget configured, an
+   over-budget submit returns HTTP 429 + ``retry_after_ms``, and the
+   unmodified agent-side retry classifier calls it transient.
+
+Exit 0 = clean; 1 = problems (one per line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from agent_tpu.agent.app import Agent
+from agent_tpu.chaos import LoopbackSession
+from agent_tpu.config import AgentConfig, Config, SchedConfig
+from agent_tpu.controller.core import Controller
+from agent_tpu.utils.retry import TRANSIENT, classify_http
+
+SHARDS = 64
+ROWS_PER_SHARD = 10
+SINGLES = 6
+
+
+def build_csv(path: str, rows: int) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("id,text,risk\n")
+        for i in range(rows):
+            f.write(f'{i},"record {i}",{(i % 13) * 0.5}\n')
+
+
+def make_agent(controller: Controller, name: str) -> Agent:
+    cfg = Config(agent=AgentConfig(
+        controller_url="http://loopback", agent_name=name,
+        tasks=("risk_accumulate",), max_tasks=2,
+        idle_sleep_sec=0.0, error_backoff_sec=0.0,
+    ))
+    agent = Agent(config=cfg, session=LoopbackSession(controller))
+    agent._profile = {"tier": "sched-smoke"}  # skip hardware probing
+    return agent
+
+
+def main() -> int:
+    problems: List[str] = []
+    controller = Controller(
+        lease_ttl_sec=30.0, sched=SchedConfig(policy="fair")
+    )
+    with tempfile.TemporaryDirectory(prefix="sched_fairness_") as tmp:
+        csv_path = os.path.join(tmp, "rows.csv")
+        build_csv(csv_path, SHARDS * ROWS_PER_SHARD)
+        shard_ids, reduce_id = controller.submit_csv_job(
+            csv_path,
+            total_rows=SHARDS * ROWS_PER_SHARD,
+            shard_size=ROWS_PER_SHARD,
+            map_op="risk_accumulate",
+            extra_payload={"field": "risk"},
+            reduce_op="risk_accumulate",
+            collect_partials=True,
+            tenant="bulk",
+        )
+        single_ids = [
+            controller.submit(
+                "risk_accumulate",
+                {
+                    "source_uri": csv_path,
+                    "start_row": k * ROWS_PER_SHARD,
+                    "shard_size": ROWS_PER_SHARD,
+                    "field": "risk",
+                },
+                tenant="rt",
+                priority=9,
+            )
+            for k in range(SINGLES)
+        ]
+
+        # Drain with the real agent loop; track the order completions land.
+        agent = make_agent(controller, "smoke-agent")
+        completion_order: List[str] = []
+        deadline = time.monotonic() + 120.0
+        while not controller.drained() and time.monotonic() < deadline:
+            leased = agent.lease_once()
+            if leased is None:
+                controller.sweep()
+                continue
+            lease_id, tasks = leased
+            for task in tasks:
+                agent.run_task(lease_id, task)
+                completion_order.append(task["id"])
+
+        if not controller.drained():
+            print(f"drain did not complete (counts {controller.counts()})")
+            return 1
+        counts = controller.counts()
+        if counts.get("dead") or counts.get("failed"):
+            problems.append(f"dead/failed jobs under fair policy: {counts}")
+
+        # Priority wins: every p9 single first-leases (and completes)
+        # before ≥90% of the bulk shards.
+        first_lease: Dict[str, int] = {}
+        for ev in controller.recorder.events():
+            if ev.get("kind") == "lease" \
+                    and ev.get("job_id") not in first_lease:
+                first_lease[ev["job_id"]] = len(first_lease)
+        bulk_pos = sorted(first_lease[j] for j in shard_ids)
+        p90_bulk = bulk_pos[int(0.9 * (len(bulk_pos) - 1))]
+        late = [j for j in single_ids if first_lease[j] > p90_bulk]
+        if late:
+            problems.append(
+                f"{len(late)}/{len(single_ids)} priority-9 jobs first-leased "
+                f"after the 90th-percentile bulk shard"
+            )
+        done_pos = {j: i for i, j in enumerate(completion_order)}
+        last_single_done = max(done_pos[j] for j in single_ids)
+        bulk_done_before = sum(
+            1 for j in shard_ids if done_pos[j] < last_single_done
+        )
+        if bulk_done_before > int(0.5 * SHARDS):
+            problems.append(
+                f"priority-9 singles completed after {bulk_done_before}/"
+                f"{SHARDS} bulk shards — priority did not complete first"
+            )
+
+        snap = controller.metrics.snapshot()
+        tenants = {
+            s["labels"].get("tenant")
+            for s in snap.get("sched_queue_depth", {}).get("series", [])
+        }
+        if not {"bulk", "rt"} <= tenants:
+            problems.append(f"sched_queue_depth tenants missing: {tenants}")
+        if not snap.get("sched_starvation_age_seconds", {}).get("series"):
+            problems.append("sched_starvation_age_seconds has no series")
+
+    # Admission backpressure: over-budget submit → 429, transient class.
+    bounded = Controller(sched=SchedConfig(
+        policy="fair", max_pending=3, retry_after_ms=250,
+    ))
+    session = LoopbackSession(bounded)
+    statuses = []
+    for i in range(5):
+        resp = session.post(
+            "http://loopback/v1/jobs",
+            json={"op": "echo", "payload": {"i": i}, "tenant": "rt"},
+        )
+        statuses.append(resp.status_code)
+    if statuses.count(429) != 2 or statuses.count(200) != 3:
+        problems.append(f"admission statuses {statuses} != [200]*3 + [429]*2")
+    else:
+        body = session.post(
+            "http://loopback/v1/jobs", json={"op": "echo"}
+        ).json()
+        if body.get("retry_after_ms") != 250:
+            problems.append(f"429 body missing retry_after_ms: {body}")
+    if classify_http(429) != TRANSIENT:
+        problems.append("classify_http(429) is not transient")
+
+    print(json.dumps({
+        "shards": SHARDS, "singles": SINGLES,
+        "p90_bulk_first_lease": p90_bulk,
+        "single_first_leases": sorted(first_lease[j] for j in single_ids),
+        "ok": not problems,
+    }, sort_keys=True))
+    if problems:
+        for p in problems:
+            print(p)
+        print(f"FAILED: {len(problems)} problem(s)")
+        return 1
+    print("sched fairness smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,42 @@ def test_lease_request_carries_protocol_fields():
     assert "metrics" in body
 
 
+def test_lease_capabilities_carry_device_and_load_fields():
+    """ISSUE 4 satellite: the lease body's capabilities ship device_kind /
+    mesh_devices (from TpuRuntime.describe()) and the staged queue_depth —
+    regardless of the controller's scheduler policy. Wire shape pinned."""
+
+    class StubRuntime:
+        def describe(self):
+            return {"platform": "tpu", "n_devices": 8, "mesh": {"dp": 8}}
+
+    session = StubSession([StubResponse(204)])
+    agent = Agent(config=fast_config(agent_name="a1"), session=session,
+                  runtime=StubRuntime())
+    agent._profile = {"tier": "test"}
+    agent.staged_depth_fn = lambda: 3
+    assert agent.lease_once() is None
+    _, body = session.requests[0]
+    assert body["capabilities"] == {
+        "ops": ["echo"],
+        "queue_depth": 3,
+        "device_kind": "tpu",
+        "mesh_devices": 8,
+    }
+
+
+def test_lease_capabilities_without_runtime_omit_device_fields():
+    """A pure-host agent (no runtime built) must not fabricate device
+    telemetry — and must not force the runtime into existence either."""
+    session = StubSession([StubResponse(204)])
+    agent = Agent(config=fast_config(), session=session)
+    agent._profile = {}
+    assert agent.lease_once() is None
+    _, body = session.requests[0]
+    assert body["capabilities"] == {"ops": ["echo"], "queue_depth": 0}
+    assert agent.runtime is None
+
+
 def test_transport_error_raises_for_backoff():
     session = StubSession([OSError("connection refused")])
     agent = Agent(config=fast_config(), session=session)

@@ -259,6 +259,34 @@ class TestJournalResume:
         assert "keep" in [t["id"] for t in c2.lease("a", {"ops": ["echo"]})["tasks"]]
         c2.close()
 
+    def test_torn_final_line_counted_and_warned(self, tmp_path):
+        """ISSUE 4 satellite: the torn-tail tolerance is no longer silent —
+        controller_journal_torn_tail_total counts it (and mid-file
+        corruption still lands in the *skipped* counter, not this one)."""
+        journal = tmp_path / "c.jsonl"
+        c1 = Controller(journal_path=str(journal))
+        c1.submit("echo", {"x": 1}, job_id="keep")
+        c1.close()
+        with open(journal, "a") as f:
+            f.write('{"ev": "submit", "job_id": "torn", "op"')
+
+        c2 = Controller(journal_path=str(journal))
+        snap = c2.metrics.snapshot()
+        (torn,) = snap["controller_journal_torn_tail_total"]["series"]
+        assert torn["value"] == 1
+        assert not snap["controller_journal_replay_skipped_total"]["series"]
+        c2.close()
+
+        # A clean journal replays with a zero torn-tail count.
+        clean = tmp_path / "clean.jsonl"
+        c3 = Controller(journal_path=str(clean))
+        c3.submit("echo", {}, job_id="j")
+        c3.close()
+        c4 = Controller(journal_path=str(clean))
+        snap = c4.metrics.snapshot()
+        assert not snap["controller_journal_torn_tail_total"]["series"]
+        c4.close()
+
     def test_corrupted_midfile_lines_warned_and_counted(self, tmp_path):
         """Mid-file corruption is NOT a torn final write: replay must skip
         it loudly (warning + counter), keep every parseable line, and still
