@@ -45,6 +45,14 @@ from agent_tpu.agent.spool import ResultSpool
 from agent_tpu.config import Config
 from agent_tpu.obs.metrics import MetricsRegistry
 from agent_tpu.obs.recorder import FlightRecorder
+from agent_tpu.obs.trace import (
+    SpanBuffer,
+    TraceContext,
+    make_span,
+    new_span_id,
+    use_context,
+)
+from agent_tpu.obs import trace as obs_trace
 from agent_tpu.ops import OpFn, load_ops
 from agent_tpu.utils.errors import structured_error
 from agent_tpu.utils.logging import RateLimiter, log
@@ -100,6 +108,7 @@ class Agent:
         runtime: Any = None,
         registry: Any = None,
         recorder: Any = None,
+        tracer: Any = None,
     ) -> None:
         self.config = config or Config.from_env()
         if session is None:
@@ -119,6 +128,14 @@ class Agent:
         )
         self.recorder: FlightRecorder = (
             recorder if recorder is not None else FlightRecorder()
+        )
+        # Distributed tracing (ISSUE 5): agent-side spans (stage/queue/
+        # execute/post, xla.compile, spool redeliveries) buffer here and
+        # piggyback onto /v1/results bodies and the lease metrics channel;
+        # the controller assembles them into per-job trees. Bounded ring;
+        # TRACE_ENABLED=0 makes every add a no-op.
+        self.tracer: SpanBuffer = (
+            tracer if tracer is not None else SpanBuffer()
         )
         self.m_tasks = self.obs.counter(
             "tasks_total", "Tasks completed by op and status",
@@ -268,8 +285,16 @@ class Agent:
         telemetry and leases nothing). Drain loops call this after the last
         result posts so the final counters reach the fleet view; best-effort
         by contract."""
+        spans: List[Dict[str, Any]] = []
         try:
             a = self.config.agent
+            metrics = self._metrics()
+            spans = self._drain_spans()
+            if spans:
+                # Final span ship (ISSUE 5): the drain-tail spans (last
+                # post/redeliver) postdate the last result post, so the
+                # flush lease is what completes the last jobs' trees.
+                metrics["spans"] = spans
             status, _ = self._post_json(
                 "/v1/leases",
                 {
@@ -277,29 +302,85 @@ class Agent:
                     "capabilities": {"ops": []},
                     "max_tasks": 0,
                     "labels": a.labels,
-                    "metrics": self._metrics(),
+                    "metrics": metrics,
                 },
                 session=session,
             )
+            if status not in (200, 204) and spans:
+                self.tracer.requeue(spans)
             return status in (200, 204)
         except Exception:  # noqa: BLE001 — flush must never fail a drain
+            if spans:
+                self.tracer.requeue(spans)
             return False
 
     def record_phase_timings(
         self, op: str, timings: Optional[Dict[str, Any]],
         keys: Optional[Tuple[str, ...]] = None,
+        trace_id: Optional[str] = None,
     ) -> None:
         """ctx.tags["timings"] (milliseconds) → ``task_phase_seconds``
         observations. ``keys`` restricts which timing keys count — the
         pipelined runner measures stage/execute/finalize wall-clock itself
         and only takes queue/fetch from the op timings (observing both would
-        double-count)."""
+        double-count). ``trace_id`` (the job id) rides along as an
+        OpenMetrics exemplar, linking the histogram bucket to the trace
+        that produced the sample (ISSUE 5)."""
+        exemplar = (
+            {"trace_id": trace_id}
+            if trace_id and obs_trace.enabled() else None
+        )
         for key, phase in PHASE_KEYS:
             if keys is not None and key not in keys:
                 continue
             v = (timings or {}).get(key)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
-                self.m_phase.observe(float(v) / 1000.0, op=op, phase=phase)
+                self.m_phase.observe(
+                    float(v) / 1000.0, exemplar=exemplar, op=op, phase=phase
+                )
+
+    # ---- distributed tracing (ISSUE 5) ----
+
+    @staticmethod
+    def task_trace(task: Any) -> Tuple[Optional[str], Optional[str]]:
+        """``(trace_id, parent_span_id)`` from the controller-stamped task
+        trace context; ``(None, None)`` for legacy tasks or a tracing-off
+        controller (agent spans are then skipped entirely)."""
+        if isinstance(task, dict) and isinstance(task.get("trace"), dict):
+            t = task["trace"]
+            tid, sid = t.get("trace_id"), t.get("span_id")
+            if isinstance(tid, str) and tid:
+                return tid, sid if isinstance(sid, str) and sid else None
+        return None, None
+
+    def _process_name(self) -> str:
+        return f"agent:{self.config.agent.agent_name}"
+
+    def trace_span(
+        self,
+        name: str,
+        trace_id: Optional[str],
+        parent_span_id: Optional[str],
+        start_mono: float,
+        duration_s: float,
+        span_id: Optional[str] = None,
+        **attributes: Any,
+    ) -> None:
+        """Buffer one closed agent-side span; no-op without a trace id or
+        with tracing disabled (the SpanBuffer short-circuits too)."""
+        if not trace_id or not obs_trace.enabled():
+            return
+        self.tracer.add(make_span(
+            name, trace_id, parent_span_id,
+            start_mono=start_mono, duration_s=duration_s, span_id=span_id,
+            process=self._process_name(),
+            attributes={k: v for k, v in attributes.items() if v is not None},
+        ))
+
+    def _drain_spans(self) -> List[Dict[str, Any]]:
+        """Pending spans for a piggyback ship ([] when tracing is off —
+        nothing accumulates then either)."""
+        return self.tracer.drain()
 
     def note_progress(self, queues: Optional[Dict[str, int]] = None) -> None:
         """Periodic progress summary (tasks/sec over the window, queue
@@ -323,6 +404,12 @@ class Agent:
         idle. Raises RuntimeError on transport/protocol errors so the caller
         applies backoff (reference ``app.py:161-195``)."""
         a = self.config.agent
+        metrics = self._metrics()
+        spans = self._drain_spans()
+        if spans:
+            # Spans piggyback on the lease metrics channel (keyed by agent
+            # like the obs snapshot); undelivered batches requeue below.
+            metrics["spans"] = spans
         status, body = self._post_json(
             "/v1/leases",
             {
@@ -332,9 +419,11 @@ class Agent:
                 "timeout_ms": a.lease_timeout_ms,
                 "labels": a.labels,
                 "worker_profile": self.worker_profile(),
-                "metrics": self._metrics(),
+                "metrics": metrics,
             },
         )
+        if status not in (200, 204) and spans:
+            self.tracer.requeue(spans)
         if status == STATUS_TRANSPORT_ERROR:
             self.m_lease.inc(outcome="error")
             raise RuntimeError(f"lease transport error: {body}")
@@ -377,20 +466,27 @@ class Agent:
         behavior this replaces, ref ``app.py:307-312``). Permanent failures
         (the controller rejected the request itself) are counted and dropped:
         resending identical bytes cannot succeed."""
+        wire: Dict[str, Any] = {
+            "lease_id": lease_id,
+            "job_id": job_id,
+            "job_epoch": job_epoch,
+            "status": status,
+            "result": result,
+            "error": error,
+        }
+        spans = self._drain_spans()
+        if spans:
+            # Spans ride the result post (ISSUE 5) — the same piggyback the
+            # metrics snapshot uses on leases. NOT stored in the spool: a
+            # failed batch requeues and ships on the next post or lease.
+            wire["spans"] = spans
         http_status, body = self._post_json(
-            "/v1/results",
-            {
-                "lease_id": lease_id,
-                "job_id": job_id,
-                "job_epoch": job_epoch,
-                "status": status,
-                "result": result,
-                "error": error,
-            },
-            session=session,
+            "/v1/results", wire, session=session,
         )
         if http_status in (200, 204):
             return True
+        if spans:
+            self.tracer.requeue(spans)
         self.m_post_fail.inc(op=op)
         failure_class = classify_http(http_status)
         self.recorder.record(
@@ -443,6 +539,7 @@ class Agent:
                 )
                 continue
             entry = self.spool.head()
+            t_try = time.perf_counter()
             status, _body = self._post_json(
                 "/v1/results", ResultSpool.wire_body(entry), session=session
             )
@@ -454,6 +551,7 @@ class Agent:
                     "result_redelivered", job_id=entry.get("job_id"),
                     op=entry.get("op"),
                 )
+                self._trace_redelivery(entry, t_try, "delivered")
                 self._spool_retry.reset()
                 self._spool_next_try = 0.0
             elif classify_http(status) == PERMANENT:
@@ -463,6 +561,7 @@ class Agent:
                     "spool_dropped_permanent", job_id=entry.get("job_id"),
                     op=entry.get("op"), status=status,
                 )
+                self._trace_redelivery(entry, t_try, "dropped_permanent")
             else:
                 # Still unreachable: back off before the next redelivery
                 # attempt so a down controller isn't hammered by the loop.
@@ -472,6 +571,27 @@ class Agent:
                 break
         self.m_spool_depth.set(len(self.spool))
         return delivered
+
+    def _trace_redelivery(
+        self, entry: Dict[str, Any], t_start: float, outcome: str
+    ) -> None:
+        """Span for one spool redelivery attempt (ISSUE 5): parents to the
+        job's lease span when the spooled result body carried the trace
+        context, so a controller blip's recovery shows on the timeline."""
+        job_id = entry.get("job_id")
+        if not isinstance(job_id, str) or not job_id:
+            return
+        parent = None
+        res = entry.get("result")
+        if isinstance(res, dict) and isinstance(res.get("trace"), dict):
+            sid = res["trace"].get("span_id")
+            parent = sid if isinstance(sid, str) and sid else None
+        self.trace_span(
+            "result.redeliver", job_id, parent,
+            start_mono=t_start,
+            duration_s=time.perf_counter() - t_start,
+            op=entry.get("op"), outcome=outcome,
+        )
 
     # ---- task execution ----
 
@@ -496,14 +616,17 @@ class Agent:
         return job_id, op, payload, epoch
 
     def _op_context(self, job_id: str, lease_id: Optional[str] = None,
-                    attempt: Any = None):
+                    attempt: Any = None, parent_span_id: Any = None):
         from agent_tpu.runtime.context import OpContext
 
         # The trace triple stamped at lease time (ISSUE 2 tentpole 5): it
         # rides ctx.tags into op timings/logs and is copied into the result
         # body, so one job's life greps across controller journal, agent
-        # logs, and both flight recorders.
+        # logs, and both flight recorders. `span_id` (ISSUE 5) is the
+        # controller's lease span — the parent of the agent-side spans.
         trace = {"job_id": job_id, "attempt": attempt, "lease_id": lease_id}
+        if parent_span_id:
+            trace["span_id"] = parent_span_id
         return OpContext(
             runtime=self.runtime, config=self.config,
             tags={"job_id": job_id, "trace": trace},
@@ -569,6 +692,7 @@ class Agent:
         t0 = time.perf_counter()
         job_id, op, payload, epoch, fn, resolve_error = self.resolve_task(task)
         attempt = task.get("attempt") if isinstance(task, dict) else None
+        trace_id, span_parent = self.task_trace(task)
         if resolve_error is not None:
             if job_id is not None:
                 self.m_tasks.inc(op=op, status="failed")
@@ -583,13 +707,32 @@ class Agent:
                 )
             return
 
-        ctx = self._op_context(job_id, lease_id=lease_id, attempt=attempt)
+        ctx = self._op_context(job_id, lease_id=lease_id, attempt=attempt,
+                               parent_span_id=span_parent)
+        # The execute span id is minted up front so compile spans emitted
+        # INSIDE the op (executor cache misses) can parent to it.
+        exec_span_id = new_span_id()
+        t_exec0 = None
         try:
             # Multi-host: every host must enter the same SPMD program in
             # lockstep — the leader publishes the task before executing it
             # (no-op on a single host). SURVEY.md §7 "multi-host control".
             self._broadcast_to_followers(op, payload)
-            result = self._maybe_profiled(op, fn, payload, ctx)
+            t_exec0 = time.perf_counter()
+            # Serial loop "stage": task resolution + the broadcast — the
+            # host-side work before the monolithic op call.
+            self.trace_span(
+                "stage", trace_id, span_parent,
+                start_mono=t0, duration_s=t_exec0 - t0, op=op,
+            )
+            with use_context(TraceContext(
+                trace_id=trace_id or job_id,
+                parent_span_id=exec_span_id,
+                tracer=self.tracer,
+                registry=self.obs,
+                process=self._process_name(),
+            )):
+                result = self._maybe_profiled(op, fn, payload, ctx)
             status = "succeeded"
             error = None
         except Exception as exc:  # noqa: BLE001 — every op error → failed result
@@ -610,20 +753,36 @@ class Agent:
                     op=op,
                 )
                 raise
-        duration_ms = (time.perf_counter() - t0) * 1000.0
+        t_done = time.perf_counter()
+        if t_exec0 is not None:
+            self.trace_span(
+                "execute", trace_id, span_parent, span_id=exec_span_id,
+                start_mono=t_exec0, duration_s=t_done - t_exec0,
+                op=op, status=status,
+            )
+        duration_ms = (t_done - t0) * 1000.0
         if isinstance(result, dict):
             result.setdefault("duration_ms", duration_ms)
             if ctx.tags.get("timings"):
                 result.setdefault("timings", ctx.tags["timings"])
             result.setdefault("trace", ctx.tags.get("trace"))
+        t_post0 = time.perf_counter()
         self.post_result(
             lease_id, job_id, epoch, status, result=result, error=error, op=op
+        )
+        # Emitted after the post (a span cannot include its own ship); it
+        # rides the NEXT post or the final metrics-only flush.
+        self.trace_span(
+            "post", trace_id, span_parent,
+            start_mono=t_post0, duration_s=time.perf_counter() - t_post0,
+            op=op, status=status,
         )
         self.tasks_done += 1
         self.m_tasks.inc(op=op, status=status)
         # Serial phases come from the op's own timings (the monolithic call
         # gives this loop no phase boundaries of its own to measure).
-        self.record_phase_timings(op, ctx.tags.get("timings"))
+        self.record_phase_timings(op, ctx.tags.get("timings"),
+                                  trace_id=job_id)
         self.recorder.record(
             "task", job_id=job_id, op=op, status=status, lease_id=lease_id,
             attempt=attempt, duration_ms=round(duration_ms, 3),

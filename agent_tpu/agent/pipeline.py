@@ -43,6 +43,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
+from agent_tpu.obs.trace import TraceContext, new_span_id, use_context
 from agent_tpu.utils.errors import structured_error
 from agent_tpu.utils.logging import log
 from agent_tpu.utils.retry import jittered
@@ -66,6 +67,13 @@ class _Item:
     status: str = "succeeded"
     error: Any = None
     monolithic: bool = False      # op has no phase hooks
+    # Tracing (ISSUE 5): the task's trace context (trace_id = job_id,
+    # span_parent = the controller's lease span) and the phase boundary the
+    # queue span is measured from. The runner's existing wall-clock phase
+    # measurements become spans — no second clock.
+    trace_id: Any = None
+    span_parent: Any = None
+    t_staged: float = 0.0         # when staging finished (queue-span start)
 
 
 _STOP = object()
@@ -115,22 +123,26 @@ class PipelineRunner:
         # the UnknownOp shape are single-sourced with the serial loop.
         job_id, op, payload, epoch, fn, resolve_error = agent.resolve_task(task)
         attempt = task.get("attempt") if isinstance(task, dict) else None
+        trace_id, span_parent = agent.task_trace(task)
         if resolve_error is not None:
             if job_id is None:
                 return None
             return _Item(
                 lease_id, job_id, epoch, op, {}, None, t0,
                 status="failed", error=resolve_error,
+                trace_id=trace_id, span_parent=span_parent,
             )
 
         item = _Item(
             lease_id, job_id, epoch, op, payload,
-            agent._op_context(job_id, lease_id=lease_id, attempt=attempt),
-            t0, fn=fn,
+            agent._op_context(job_id, lease_id=lease_id, attempt=attempt,
+                              parent_span_id=span_parent),
+            t0, fn=fn, trace_id=trace_id, span_parent=span_parent,
         )
         stage = getattr(fn, "stage", None)
         if stage is None:
             item.monolithic = True
+            item.t_staged = time.perf_counter()
             return item
         try:
             phase, value = stage(payload, item.ctx)
@@ -144,8 +156,15 @@ class PipelineRunner:
                 type=type(exc).__name__, message=str(exc)[:200],
             )
             return item
+        item.t_staged = time.perf_counter()
         agent.m_phase.observe(
-            time.perf_counter() - t0, op=op, phase="stage"
+            item.t_staged - t0,
+            exemplar={"trace_id": job_id}, op=op, phase="stage",
+        )
+        # The runner's existing stage measurement, as a span (ISSUE 5).
+        agent.trace_span(
+            "stage", trace_id, span_parent,
+            start_mono=t0, duration_s=item.t_staged - t0, op=op,
         )
         agent.recorder.record(
             "phase", phase="staged", job_id=job_id, op=op,
@@ -246,19 +265,38 @@ class PipelineRunner:
                     self._put_post(item)
                     continue
                 t_exec = time.perf_counter()
+                if item.t_staged:
+                    # Time spent waiting in the staged queue — the
+                    # backpressure gap between host staging and the device.
+                    agent.trace_span(
+                        "queue", item.trace_id, item.span_parent,
+                        start_mono=item.t_staged,
+                        duration_s=t_exec - item.t_staged, op=item.op,
+                    )
+                # Pre-minted so compile spans emitted inside the dispatch
+                # (executor cache misses) parent to this execute span.
+                exec_span_id = new_span_id()
+                trace_ctx = TraceContext(
+                    trace_id=item.trace_id or item.job_id,
+                    parent_span_id=exec_span_id,
+                    tracer=agent.tracer,
+                    registry=agent.obs,
+                    process=agent._process_name(),
+                )
                 try:
                     # profiled_call covers phased ops too — PROFILE_DIR
                     # traces capture the device phase either way (§5.1).
-                    if item.monolithic:
-                        item.result = agent.profiled_call(
-                            item.op,
-                            lambda i=item: i.fn(i.payload, i.ctx),
-                        )
-                    else:
-                        item.executed = agent.profiled_call(
-                            item.op,
-                            lambda i=item: i.fn.execute(i.staged, i.ctx),
-                        )
+                    with use_context(trace_ctx):
+                        if item.monolithic:
+                            item.result = agent.profiled_call(
+                                item.op,
+                                lambda i=item: i.fn(i.payload, i.ctx),
+                            )
+                        else:
+                            item.executed = agent.profiled_call(
+                                item.op,
+                                lambda i=item: i.fn.execute(i.staged, i.ctx),
+                            )
                 except Exception as exc:  # noqa: BLE001 — op error → failed
                     item.status = "failed"
                     item.error = structured_error(exc)
@@ -271,7 +309,15 @@ class PipelineRunner:
                     )
                 dt = time.perf_counter() - t_exec
                 agent.m_device_busy.inc(dt)
-                agent.m_phase.observe(dt, op=item.op, phase="execute")
+                agent.m_phase.observe(
+                    dt, exemplar={"trace_id": item.job_id},
+                    op=item.op, phase="execute",
+                )
+                agent.trace_span(
+                    "execute", item.trace_id, item.span_parent,
+                    span_id=exec_span_id, start_mono=t_exec, duration_s=dt,
+                    op=item.op, status=item.status,
+                )
                 agent.recorder.record(
                     "phase", phase="executed", job_id=item.job_id,
                     op=item.op, lease_id=item.lease_id,
@@ -317,7 +363,10 @@ class PipelineRunner:
                     type=type(exc).__name__, message=str(exc)[:200],
                 )
             finalize_s = time.perf_counter() - t_fin
-            agent.m_phase.observe(finalize_s, op=item.op, phase="finalize")
+            agent.m_phase.observe(
+                finalize_s, exemplar={"trace_id": item.job_id},
+                op=item.op, phase="finalize",
+            )
             duration_ms = (time.perf_counter() - item.t_start) * 1000.0
             if item.ctx is not None:
                 timings = item.ctx.tags.setdefault("timings", {})
@@ -329,7 +378,8 @@ class PipelineRunner:
                 # finalize were measured wall-clock by the runner threads
                 # (observing both views would double-count those phases).
                 agent.record_phase_timings(
-                    item.op, timings, keys=("queue_ms", "fetch_ms")
+                    item.op, timings, keys=("queue_ms", "fetch_ms"),
+                    trace_id=item.job_id,
                 )
             if isinstance(item.result, dict):
                 item.result.setdefault("duration_ms", duration_ms)
@@ -345,6 +395,16 @@ class PipelineRunner:
                 item.lease_id, item.job_id, item.epoch, item.status,
                 result=item.result, error=item.error, session=session,
                 op=item.op,
+            )
+            # Poster-thread cost as one span: finalize (incl. the deferred
+            # device→host fetch) + the result post. Ships on the NEXT post
+            # or the final metrics-only flush.
+            agent.trace_span(
+                "post", item.trace_id, item.span_parent,
+                start_mono=t_fin,
+                duration_s=time.perf_counter() - t_fin,
+                op=item.op, status=item.status,
+                finalize_ms=round(finalize_s * 1e3, 3),
             )
             # Spooled redelivery rides the poster cadence (backoff-gated
             # inside flush_spool) — the pipelined drain heals from a

@@ -154,6 +154,7 @@ class LoopbackSession:
                 status=str(body.get("status", "")),
                 result=body.get("result"),
                 error=body.get("error"),
+                spans=body.get("spans"),
             )
             return _FakeResponse(200, out)
         if path == "jobs":

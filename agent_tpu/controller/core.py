@@ -69,6 +69,8 @@ from agent_tpu.obs.metrics import (
     render_snapshots,
 )
 from agent_tpu.obs.recorder import FlightRecorder
+from agent_tpu.obs.trace import TraceStore
+from agent_tpu.obs import trace as obs_trace
 from agent_tpu.sched import (
     DEFAULT_PRIORITY,
     DEFAULT_TENANT,
@@ -151,9 +153,16 @@ class Job:
     # Times the fair policy skipped this job waiting for a better-placed
     # agent; capped by SCHED_PLACEMENT_PATIENCE so preference never starves.
     placement_defers: int = 0
+    # Distributed tracing (ISSUE 5): the job-lifetime root span opened at
+    # submit, the currently-open lease span agent-side spans parent to, and
+    # the controller-clock instant the job last became queued (what the
+    # sched.decide span measures its wait from).
+    root_span_id: Optional[str] = None
+    lease_span_id: Optional[str] = None
+    enqueued_clock: float = 0.0
 
     def to_task(self) -> Dict[str, Any]:
-        return {
+        task = {
             "id": self.job_id,
             "op": self.op,
             "payload": self.payload,
@@ -163,6 +172,15 @@ class Job:
             # greps across journal, agent logs, and both flight recorders.
             "attempt": self.attempts,
         }
+        if self.lease_span_id is not None:
+            # Causal parenting (ISSUE 5): agent-side stage/execute/post
+            # spans hang off the lease span. Absent when tracing is off,
+            # keeping the wire byte-identical to the pre-trace protocol.
+            task["trace"] = {
+                "trace_id": self.job_id,
+                "span_id": self.lease_span_id,
+            }
+        return task
 
 
 class Controller:
@@ -177,6 +195,7 @@ class Controller:
         max_attempts: int = DEFAULT_MAX_ATTEMPTS,
         requeue_delay_sec: float = 0.0,
         sched: Optional[SchedConfig] = None,
+        trace_store: Optional[TraceStore] = None,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
         self.max_attempts = max(1, int(max_attempts))
@@ -195,6 +214,11 @@ class Controller:
         # conflate their series with the scheduler's.
         self.metrics = registry if registry is not None else MetricsRegistry()
         self.recorder = recorder if recorder is not None else FlightRecorder()
+        # Distributed tracing (ISSUE 5): the assembly point for the swarm's
+        # span trees — controller-side spans land here directly; agent-side
+        # spans arrive piggybacked on results/leases and are ingested, deduped
+        # by span_id. Bounded like the flight recorder.
+        self.traces = trace_store if trace_store is not None else TraceStore()
         # Per-agent telemetry keyed by agent id (replaces the overwritten
         # last_metrics as the fleet source of truth; last_metrics is kept as
         # the legacy /v1/status field). Each entry: {last_seen_wall, metrics
@@ -264,10 +288,7 @@ class Controller:
             "reason DeadlineExceeded)", ("op",))
         # The policy object every lease decision delegates to (ISSUE 4).
         self._sched = make_scheduler(
-            self.sched_config,
-            on_decision=lambda decision: self._m_sched_decisions.inc(
-                policy=self.sched_config.policy, decision=decision
-            ),
+            self.sched_config, on_decision=self._on_sched_decision
         )
         # Queued job ids currently held back by a requeue delay — the small
         # set scanned to split the depth gauge into leasable vs held.
@@ -294,6 +315,38 @@ class Controller:
         self._sweep_stop = threading.Event()
         if sweep_interval_sec:
             self.start_sweeper(sweep_interval_sec)
+
+    def _on_sched_decision(
+        self, decision: str, job_id: Optional[str] = None
+    ) -> None:
+        """Policy decision hook: counts every decision; policy decisions
+        that name a job (placement deferrals) additionally leave an instant
+        ``sched.defer`` span on the job's trace, so a deferred-placement
+        wait is visible in the timeline, not just the aggregate counter.
+        Called under the controller lock (from inside ``lease``)."""
+        self._m_sched_decisions.inc(
+            policy=self.sched_config.policy, decision=decision
+        )
+        if job_id is None:
+            return
+        job = self._jobs.get(job_id)
+        if job is None or job.root_span_id is None:
+            return
+        self.traces.add({
+            "trace_id": job_id,
+            "span_id": obs_trace.new_span_id(),
+            "parent_span_id": job.root_span_id,
+            "name": "sched.defer",
+            "start_wall": time.time(),
+            "start_mono": self._clock(),
+            "duration_ms": 0.0,
+            "process": "controller",
+            "attributes": {
+                "decision": decision,
+                "policy": self.sched_config.policy,
+                "defers": job.placement_defers,
+            },
+        })
 
     @property
     def _queue(self) -> List[str]:
@@ -431,6 +484,13 @@ class Controller:
                 # Deadlines re-anchor to replay time (the journal carries no
                 # wall clock); queue-wait attribution restarts here too.
                 job.submitted_at = now
+                job.enqueued_clock = now
+                # Traces are in-memory and did not survive the restart: a
+                # fresh root span lets post-restart spans still assemble.
+                job.root_span_id = self.traces.open(
+                    job.job_id, "submit", start_clock=now,
+                    attributes={"op": job.op, "replayed": True},
+                )
                 self._sched.add(job)
                 if job.deadline_sec is not None:
                     self._deadlined.add(job.job_id)
@@ -611,6 +671,15 @@ class Controller:
             self._admit_locked(job.tenant)
             now = self._clock()
             job.submitted_at = now
+            job.enqueued_clock = now
+            # Root of the job's span tree (ISSUE 5): open at submit, closed
+            # when the job reaches a terminal state. trace_id = job_id.
+            job.root_span_id = self.traces.open(
+                job_id, "submit", start_clock=now,
+                attributes={
+                    "op": op, "tenant": job.tenant, "priority": job.priority,
+                },
+            )
             self._jobs[job_id] = job
             self._sched.add(job)
             if job.deadline_sec is not None:
@@ -773,6 +842,12 @@ class Controller:
                 job.epoch += 1
                 job.state = PENDING
                 job.lease_id = None
+                self.traces.finish(
+                    job.job_id, job.lease_span_id, now,
+                    attributes={"outcome": "expired"},
+                )
+                job.lease_span_id = None
+                job.enqueued_clock = now
                 self._sched.add(job)
                 self._m_expirations.inc(op=job.op)
                 self._update_queue_stats_locked(now)
@@ -817,6 +892,10 @@ class Controller:
                     "trace": "",
                 }
                 job.state = DEAD
+                self.traces.finish(
+                    job.job_id, job.root_span_id, now,
+                    attributes={"outcome": DEAD, "reason": "DeadlineExceeded"},
+                )
                 self._m_dead.inc(op=job.op)
                 self._m_deadline_dead.inc(op=job.op)
                 self._m_sched_decisions.inc(
@@ -922,6 +1001,14 @@ class Controller:
         with self._lock:
             now_wall = time.time()
             if metrics:
+                # Piggybacked agent spans (ISSUE 5): the lease `metrics`
+                # channel doubles as the span ship — including the
+                # metrics-only flush at drain end — keyed by agent id like
+                # the obs snapshot, deduped by span_id at the store.
+                piggyback = metrics.pop("spans", None) \
+                    if isinstance(metrics, dict) else None
+                if piggyback:
+                    self.traces.ingest(piggyback)
                 self.last_metrics = metrics
                 if agent:
                     self.agent_metrics[agent] = {
@@ -1002,10 +1089,45 @@ class Controller:
                     # (a retry's wait measures failure handling, not
                     # scheduling pressure).
                     self._m_queue_wait.observe(
-                        max(0.0, now - job.submitted_at), op=job.op
+                        max(0.0, now - job.submitted_at),
+                        exemplar={"trace_id": job.job_id},
+                        op=job.op,
                     )
                     self._m_starvation.observe(
                         max(0.0, now - job.submitted_at), tenant=job.tenant
+                    )
+                if job.root_span_id is not None:
+                    # The scheduling wait as a span: last-enqueued → this
+                    # grant, annotated with the policy's deferral/held
+                    # history so "why did this job sit" reads off the trace.
+                    wait = max(0.0, now - job.enqueued_clock)
+                    self.traces.add({
+                        "trace_id": job.job_id,
+                        "span_id": obs_trace.new_span_id(),
+                        "parent_span_id": job.root_span_id,
+                        "name": "sched.decide",
+                        "start_wall": time.time() - wait,
+                        "start_mono": job.enqueued_clock,
+                        "duration_ms": round(wait * 1e3, 3),
+                        "process": "controller",
+                        "attributes": {
+                            "decision": "leased",
+                            "policy": self.sched_config.policy,
+                            "attempt": job.attempts,
+                            "placement_defers": job.placement_defers,
+                            "held": job.not_before > job.enqueued_clock,
+                            "agent": agent,
+                        },
+                    })
+                    # The lease window stays open until the result applies
+                    # or the TTL expires; agent-side spans parent to it.
+                    job.lease_span_id = self.traces.open(
+                        job.job_id, "lease",
+                        parent_span_id=job.root_span_id, start_clock=now,
+                        attributes={
+                            "lease_id": lease_id, "agent": agent,
+                            "epoch": job.epoch, "attempt": job.attempts,
+                        },
                     )
                 self.recorder.record(
                     "lease", job_id=job.job_id, op=job.op,
@@ -1057,9 +1179,16 @@ class Controller:
         status: str,
         result: Any = None,
         error: Any = None,
+        spans: Any = None,
         **_ignored: Any,
     ) -> Dict[str, Any]:
-        """One result post. Stale epochs are counted and discarded."""
+        """One result post. Stale epochs are counted and discarded.
+
+        ``spans`` is the agent's piggybacked span batch (ISSUE 5) — ingested
+        regardless of whether the result is accepted (a fenced result's
+        execution still happened and belongs on the timeline)."""
+        if spans:
+            self.traces.ingest(spans)
         with self._lock:
             job = self._jobs.get(job_id)
             if job is None:
@@ -1093,6 +1222,7 @@ class Controller:
                 return {"accepted": False, "reason": "already complete"}
             # result/error before state: unlocked readers keying on a
             # terminal state must never see it paired with a stale result.
+            t_apply = self._clock()
             job.result = result
             job.error = error
             job.state = SUCCEEDED if status == "succeeded" else FAILED
@@ -1142,6 +1272,38 @@ class Controller:
                         "dead", job_id=job_id, op=job.op,
                         attempts=job.attempts, budget=budget,
                     )
+            now = self._clock()
+            self.traces.finish(
+                job.job_id, job.lease_span_id, now,
+                attributes={"outcome": job.state},
+            )
+            job.lease_span_id = None
+            if job.root_span_id is not None:
+                # The controller-side application itself (state transition +
+                # retry classification + journal ordering), closing the
+                # submit→…→apply chain.
+                self.traces.add({
+                    "trace_id": job.job_id,
+                    "span_id": obs_trace.new_span_id(),
+                    "parent_span_id": job.root_span_id,
+                    "name": "apply",
+                    "start_wall": time.time() - max(0.0, now - t_apply),
+                    "start_mono": t_apply,
+                    "duration_ms": round(max(0.0, now - t_apply) * 1e3, 3),
+                    "process": "controller",
+                    "attributes": {
+                        "outcome": job.state, "attempt": job.attempts,
+                    },
+                })
+            if job.state in TERMINAL_STATES:
+                self.traces.finish(
+                    job.job_id, job.root_span_id, now,
+                    attributes={"outcome": job.state},
+                )
+            else:
+                # Transient-failure requeue: the next sched.decide span
+                # measures its wait from here.
+                job.enqueued_clock = now
             # Journal the post-decision state (not the raw report): replay
             # applies it verbatim, so a failed-then-requeued job replays as
             # pending at the bumped epoch and a completed shard stays done.
@@ -1274,6 +1436,16 @@ class Controller:
             (self.fleet_snapshot(), {}),
             (liveness, {}),
         ])
+
+    def trace_json(self, job_id: str) -> Optional[Dict[str, Any]]:
+        """Assembled span tree for one job (``GET /v1/trace/{job_id}``):
+        spans sorted by wall start, orphans flagged, completeness = one root
+        + no orphans + every span closed. None for unknown traces."""
+        return self.traces.assemble(job_id)
+
+    def traces_json(self, limit: int = 20) -> List[Dict[str, Any]]:
+        """Newest-first trace summaries (``GET /v1/traces?limit=N``)."""
+        return self.traces.summaries(limit)
 
     def status_summary(self) -> Dict[str, Any]:
         """Structured rollup for /v1/status: per-op task counts + throughput

@@ -13,6 +13,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
 
 from agent_tpu.controller.core import Controller
 from agent_tpu.sched import AdmissionError
@@ -88,6 +89,9 @@ class _Handler(BaseHTTPRequestHandler):
                 status=str(body.get("status", "")),
                 result=body.get("result"),
                 error=body.get("error"),
+                # Piggybacked agent spans (ISSUE 5) — optional, absent from
+                # legacy agents.
+                spans=body.get("spans"),
             )
             self._send(200, out)
         elif self.path == "/v1/jobs":
@@ -174,6 +178,55 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no route {self.path}"})
 
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        parts = urlsplit(self.path)
+        path, query = parts.path, parse_qs(parts.query)
+        if path == "/v1/traces":
+            # Newest-first trace summaries; ?limit=N caps the listing.
+            try:
+                limit = int(query.get("limit", ["20"])[0])
+            except ValueError:
+                self._send(400, {"error": "limit must be an int"})
+                return
+            self._send(200, {"traces": self.controller.traces_json(limit)})
+            return
+        if path.startswith("/v1/trace/"):
+            # Assembled span tree for one job. ?format=perfetto returns the
+            # Chrome-trace JSON Perfetto loads directly; ?format=jsonl the
+            # span-per-line dump; default is the assembled wire schema.
+            job_id = path[len("/v1/trace/"):]
+            assembled = self.controller.trace_json(job_id)
+            if assembled is None:
+                self._send(404, {"error": f"no trace for job {job_id!r}"})
+                return
+            fmt = query.get("format", ["json"])[0]
+            if fmt == "perfetto":
+                from agent_tpu.obs.trace import to_chrome_trace
+
+                self._send(200, to_chrome_trace(assembled["spans"]))
+            elif fmt == "jsonl":
+                from agent_tpu.obs.trace import to_jsonl
+
+                self._send_text(
+                    200, to_jsonl(assembled["spans"]),
+                    "application/jsonl; charset=utf-8",
+                )
+            else:
+                self._send(200, assembled)
+            return
+        if path == "/v1/debug/events":
+            # Flight-recorder dump on demand — the controller half of the
+            # post-hoc diagnosis story (the agent half is SIGUSR1).
+            # ?job_id= filters to one job's life (ISSUE 5 satellite).
+            job_id = query.get("job_id", [None])[0]
+            self._send(
+                200,
+                {
+                    "events": self.controller.recorder.events(job_id=job_id),
+                    "dropped": self.controller.recorder.dropped,
+                    "capacity": self.controller.recorder.capacity,
+                },
+            )
+            return
         if self.path == "/v1/status":
             self._send(
                 200,
@@ -195,17 +248,6 @@ class _Handler(BaseHTTPRequestHandler):
                 200,
                 self.controller.metrics_text(),
                 "text/plain; version=0.0.4; charset=utf-8",
-            )
-        elif self.path == "/v1/debug/events":
-            # Flight-recorder dump on demand — the controller half of the
-            # post-hoc diagnosis story (the agent half is SIGUSR1).
-            self._send(
-                200,
-                {
-                    "events": self.controller.recorder.events(),
-                    "dropped": self.controller.recorder.dropped,
-                    "capacity": self.controller.recorder.capacity,
-                },
             )
         elif self.path.startswith("/v1/jobs/"):
             job_id = self.path[len("/v1/jobs/"):]

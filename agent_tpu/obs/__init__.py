@@ -17,6 +17,7 @@ from agent_tpu.obs.metrics import (
     get_registry,
     histogram_quantile,
     merge_snapshots,
+    parse_exemplars,
     parse_exposition,
     render_snapshots,
     validate_exposition,
@@ -27,8 +28,22 @@ from agent_tpu.obs.recorder import (
     get_recorder,
     install_sigusr1_dump,
 )
+from agent_tpu.obs.trace import (
+    Span,
+    SpanBuffer,
+    TraceContext,
+    TraceStore,
+    to_chrome_trace,
+    validate_chrome_trace,
+)
 
 __all__ = [
+    "Span",
+    "SpanBuffer",
+    "TraceContext",
+    "TraceStore",
+    "to_chrome_trace",
+    "validate_chrome_trace",
     "DEFAULT_BUCKETS",
     "Counter",
     "Gauge",
@@ -39,6 +54,7 @@ __all__ = [
     "get_recorder",
     "histogram_quantile",
     "merge_snapshots",
+    "parse_exemplars",
     "parse_exposition",
     "render_snapshots",
     "validate_exposition",

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 # Seconds-oriented bounds: task phases run 5ms (host stage of a tiny shard)
@@ -166,7 +167,17 @@ class Histogram(_Metric):
             raise ValueError(f"{name}: buckets must be finite (+Inf is implicit)")
         self.buckets = bounds
 
-    def observe(self, value: float, **labels: Any) -> None:
+    def observe(
+        self,
+        value: float,
+        exemplar: Optional[Mapping[str, Any]] = None,
+        **labels: Any,
+    ) -> None:
+        """Record one observation. ``exemplar`` (OpenMetrics: a small label
+        set like ``{"trace_id": job_id}``) is attached to the landing
+        bucket — latest observation wins — and rendered as an exemplar on
+        that bucket's exposition line, linking the histogram to the trace
+        that produced the sample (ISSUE 5)."""
         v = float(value)
         key = self._key(labels)
         with self._lock:
@@ -186,6 +197,12 @@ class Histogram(_Metric):
             series["counts"][i] += 1
             series["sum"] += v
             series["count"] += 1
+            if exemplar:
+                series.setdefault("exemplars", {})[str(i)] = {
+                    "labels": {str(k): str(lv) for k, lv in exemplar.items()},
+                    "value": v,
+                    "ts": time.time(),
+                }
 
 
 class MetricsRegistry:
@@ -245,12 +262,21 @@ class MetricsRegistry:
                 for key, value in m._series.items():
                     labels = dict(zip(m.labelnames, key))
                     if isinstance(m, Histogram):
-                        fam["series"].append({
+                        entry = {
                             "labels": labels,
                             "counts": list(value["counts"]),
                             "sum": value["sum"],
                             "count": value["count"],
-                        })
+                        }
+                        if value.get("exemplars"):
+                            # Only when present: snapshots without exemplars
+                            # keep the exact pre-ISSUE-5 shape (merge and
+                            # old scrapers unaffected).
+                            entry["exemplars"] = {
+                                k: dict(v)
+                                for k, v in value["exemplars"].items()
+                            }
+                        fam["series"].append(entry)
                     else:
                         fam["series"].append(
                             {"labels": labels, "value": value}
@@ -327,6 +353,15 @@ def merge_snapshots(snapshots: Iterable[Mapping[str, Any]]) -> Dict[str, Any]:
                         ]
                         have["sum"] += float(s.get("sum", 0.0))
                         have["count"] += int(s.get("count", 0))
+                        for slot, ex in (s.get("exemplars") or {}).items():
+                            if not isinstance(ex, Mapping):
+                                continue
+                            dst_ex = have.setdefault("exemplars", {})
+                            prev = dst_ex.get(slot)
+                            # Latest observation wins across the fleet.
+                            if prev is None or float(ex.get("ts", 0.0)) >= \
+                                    float(prev.get("ts", 0.0)):
+                                dst_ex[slot] = dict(ex)
                 else:
                     if have is None:
                         have = {"labels": dict(labels), "value": 0.0}
@@ -385,18 +420,21 @@ def render_snapshots(
                 if kind == "histogram":
                     bounds = [float(b) for b in fam.get("buckets", [])]
                     counts = list(s.get("counts", []))
+                    exemplars = s.get("exemplars") or {}
                     cum = 0
-                    for bound, c in zip(bounds, counts):
+                    for j, (bound, c) in enumerate(zip(bounds, counts)):
                         cum += c
                         lines.append(
                             f"{name}_bucket"
                             f"{_labels_text({**labels, 'le': _fmt_bound(bound)})}"
                             f" {cum}"
+                            f"{_exemplar_text(exemplars.get(str(j)))}"
                         )
                     lines.append(
                         f"{name}_bucket"
                         f"{_labels_text({**labels, 'le': '+Inf'})}"
                         f" {int(s.get('count', 0))}"
+                        f"{_exemplar_text(exemplars.get(str(len(bounds))))}"
                     )
                     lines.append(
                         f"{name}_sum{_labels_text(labels)}"
@@ -421,6 +459,22 @@ def _labels_text(labels: Mapping[str, Any]) -> str:
         f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
     )
     return "{" + inner + "}"
+
+
+def _exemplar_text(exemplar: Optional[Mapping[str, Any]]) -> str:
+    """OpenMetrics exemplar suffix for one bucket sample:
+    `` # {trace_id="job-x"} 0.052 1700000000.5`` — the metrics→traces link
+    (ISSUE 5). Empty string when the bucket carries none."""
+    if not exemplar or not isinstance(exemplar.get("labels"), Mapping):
+        return ""
+    labels = _labels_text(exemplar["labels"])
+    if not labels:
+        return ""
+    out = f" # {labels} {_fmt_num(float(exemplar.get('value', 0.0)))}"
+    ts = exemplar.get("ts")
+    if isinstance(ts, (int, float)) and not isinstance(ts, bool):
+        out += f" {round(float(ts), 3)}"
+    return out
 
 
 def histogram_quantile(
@@ -461,6 +515,22 @@ _SAMPLE_RE = re.compile(
 _LABEL_PAIR_RE = re.compile(
     r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"'
 )
+# OpenMetrics exemplar suffix on a sample line:
+#   `` # {trace_id="job-x"} 0.052 1700000000.5`` (timestamp optional).
+# Split off BEFORE the sample regex — the greedy label block would
+# otherwise swallow the exemplar's braces into the labels.
+_EXEMPLAR_SUFFIX_RE = re.compile(
+    r"\s#\s\{(.*)\}\s+([^\s]+)(?:\s+([0-9.eE+-]+))?\s*$"
+)
+
+
+def _split_exemplar(
+    line: str,
+) -> Tuple[str, Optional[Tuple[str, str, Optional[str]]]]:
+    m = _EXEMPLAR_SUFFIX_RE.search(line)
+    if m is None:
+        return line, None
+    return line[: m.start()], (m.group(1), m.group(2), m.group(3))
 
 
 def _unescape_label(value: str) -> str:
@@ -484,6 +554,7 @@ def parse_exposition(
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        line, _exemplar = _split_exemplar(line)
         m = _SAMPLE_RE.match(line)
         if m is None:
             raise ValueError(f"line {lineno}: malformed sample {line!r}")
@@ -507,6 +578,42 @@ def parse_exposition(
                 f"line {lineno}: non-numeric value {raw!r}"
             ) from exc
         out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def parse_exemplars(
+    text: str,
+) -> Dict[str, List[Tuple[Dict[str, str], Dict[str, str], float]]]:
+    """Exemplars per sample name: ``{sample_name: [(sample_labels,
+    exemplar_labels, exemplar_value), ...]}`` — what the trace-pipeline
+    smoke uses to assert ``task_phase_seconds`` buckets link to real job
+    ids. Lines without exemplars are skipped; malformed ones raise."""
+    out: Dict[str, List[Tuple[Dict[str, str], Dict[str, str], float]]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        line, exemplar = _split_exemplar(line)
+        if exemplar is None:
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: malformed sample {line!r}")
+        labels: Dict[str, str] = {}
+        if m.group(2):
+            for pm in _LABEL_PAIR_RE.finditer(m.group(2)):
+                labels[pm.group(1)] = _unescape_label(pm.group(2))
+        ex_block, ex_raw, _ex_ts = exemplar
+        ex_labels: Dict[str, str] = {}
+        for pm in _LABEL_PAIR_RE.finditer(ex_block):
+            ex_labels[pm.group(1)] = _unescape_label(pm.group(2))
+        try:
+            ex_value = float(ex_raw)
+        except ValueError as exc:
+            raise ValueError(
+                f"line {lineno}: non-numeric exemplar value {ex_raw!r}"
+            ) from exc
+        out.setdefault(m.group(1), []).append((labels, ex_labels, ex_value))
     return out
 
 
@@ -542,11 +649,30 @@ def validate_exposition(
                 else:
                     types[parts[2]] = parts[3]
             continue
+        stripped, exemplar = _split_exemplar(stripped)
         m = _SAMPLE_RE.match(stripped)
         if m is None:
             problems.append(f"line {lineno}: malformed sample {stripped!r}")
             continue
         name, labelblock, raw = m.group(1), m.group(2), m.group(3)
+        if exemplar is not None:
+            if not name.endswith("_bucket"):
+                problems.append(
+                    f"line {lineno}: exemplar on non-bucket sample {name}"
+                )
+            elif not _LABEL_PAIR_RE.search(exemplar[0]):
+                problems.append(
+                    f"line {lineno}: malformed exemplar labels "
+                    f"{exemplar[0]!r}"
+                )
+            else:
+                try:
+                    float(exemplar[1])
+                except ValueError:
+                    problems.append(
+                        f"line {lineno}: non-numeric exemplar value "
+                        f"{exemplar[1]!r}"
+                    )
         try:
             float(raw)
         except ValueError:

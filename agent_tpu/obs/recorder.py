@@ -20,6 +20,7 @@ journal, agent logs, and both recorders.
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import os
 import tempfile
@@ -28,6 +29,12 @@ import time
 from typing import Any, Dict, List, Optional
 
 DEFAULT_CAPACITY = 2048
+
+# Process-wide event sequence (ISSUE 5 satellite): every recorder instance
+# draws from ONE counter, so controller and agent rings in the same process
+# interleave deterministically by `seq` (cross-process dumps interleave on
+# the `ts`/`mono` pair, with `seq` breaking same-process ties).
+_global_seq = itertools.count(1)
 
 
 class FlightRecorder:
@@ -48,16 +55,31 @@ class FlightRecorder:
         self._dropped = 0  # events pushed out of the ring
 
     def record(self, kind: str, **fields: Any) -> None:
-        event = {"ts": self._clock(), "kind": kind}
+        # `ts` (wall, or the injected clock) + `mono` + process-global `seq`
+        # let controller and agent dumps interleave deterministically
+        # (ISSUE 5 satellite): sort on (ts, seq) across files.
+        event = {
+            "ts": self._clock(),
+            "mono": time.monotonic(),
+            "seq": next(_global_seq),
+            "kind": kind,
+        }
         event.update(fields)
         with self._lock:
             if len(self._events) == self.capacity:
                 self._dropped += 1
             self._events.append(event)
 
-    def events(self) -> List[Dict[str, Any]]:
+    def events(
+        self, job_id: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        """All buffered events, optionally filtered to one job's life
+        (the ``GET /v1/debug/events?job_id=`` surface)."""
         with self._lock:
-            return list(self._events)
+            out = list(self._events)
+        if job_id is not None:
+            out = [e for e in out if e.get("job_id") == job_id]
+        return out
 
     @property
     def dropped(self) -> int:

@@ -1,16 +1,19 @@
-"""Scrape-side helpers: read ``GET /v1/metrics`` back into numbers.
+"""Scrape-side helpers: read ``GET /v1/metrics`` and ``/v1/trace`` back.
 
 ``bench.py`` and ``scripts/drain_at_scale.py`` attribute drain time per op
 by scraping the controller's exposition instead of re-deriving spans from
 result bodies (``utils/spans.py`` stays as the fallback when scraping is
-unavailable — e.g. a controller predating the endpoint). Stdlib-only, like
-the rest of ``agent_tpu.obs``.
+unavailable — e.g. a controller predating the endpoint), and fetch the
+slowest job's assembled trace for a per-phase breakdown line (ISSUE 5
+satellite: a broken trace path fails loudly in bench runs instead of
+rotting silently). Stdlib-only, like the rest of ``agent_tpu.obs``.
 """
 
 from __future__ import annotations
 
+import json
 import urllib.request
-from typing import Dict, Iterable, Optional
+from typing import Any, Dict, Iterable, Optional
 
 from agent_tpu.obs.metrics import parse_exposition
 
@@ -54,3 +57,48 @@ def op_phase_seconds(
         if op in out and labels.get("phase") in phases:
             out[op] += value
     return out
+
+
+# ---- trace endpoints (ISSUE 5) ----
+
+def fetch_json(
+    base_url: str, path: str, timeout: float = 10.0
+) -> Optional[Any]:
+    """GET ``<base_url><path>`` → parsed JSON, or None on any failure."""
+    url = base_url.rstrip("/") + path
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            if resp.status != 200:
+                return None
+            return json.loads(resp.read().decode("utf-8", errors="replace"))
+    except Exception:  # noqa: BLE001 — scrape is best-effort by contract
+        return None
+
+
+def fetch_trace(
+    base_url: str, job_id: str, timeout: float = 10.0
+) -> Optional[Dict[str, Any]]:
+    """``GET /v1/trace/{job_id}`` → the assembled span tree, or None."""
+    out = fetch_json(base_url, f"/v1/trace/{job_id}", timeout=timeout)
+    return out if isinstance(out, dict) else None
+
+
+def slowest_trace(
+    base_url: str, limit: int = 64, timeout: float = 10.0
+) -> Optional[Dict[str, Any]]:
+    """The assembled trace of the slowest job in the controller's trace
+    window (largest closed root duration) — what the bench/drain scripts
+    print a phase-breakdown line for. None when the trace path is down."""
+    listing = fetch_json(base_url, f"/v1/traces?limit={int(limit)}",
+                         timeout=timeout)
+    if not isinstance(listing, dict):
+        return None
+    candidates = [
+        t for t in listing.get("traces", [])
+        if isinstance(t, dict)
+        and isinstance(t.get("root_duration_ms"), (int, float))
+    ]
+    if not candidates:
+        return None
+    worst = max(candidates, key=lambda t: t["root_duration_ms"])
+    return fetch_trace(base_url, worst["trace_id"], timeout=timeout)

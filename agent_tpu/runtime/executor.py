@@ -15,7 +15,10 @@ mesh axis sizes). Stats are exported for the metrics channel (SURVEY.md §5.5).
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, Hashable, Tuple
+import time
+from typing import Any, Callable, Dict, Hashable, Optional, Tuple
+
+from agent_tpu.obs import trace as obs_trace
 
 
 class ExecutableCache:
@@ -26,13 +29,22 @@ class ExecutableCache:
     A single lock guards the map; the build itself runs outside the lock so a
     slow XLA compile does not serialize unrelated ops, with a per-key event so
     concurrent builders of the same key trigger exactly one build.
+
+    Compile-cost attribution (ISSUE 5): with ``trace_label`` set (the
+    default, ``"xla.compile"``), every miss emits a span named after it —
+    attributed to the ambient :mod:`agent_tpu.obs.trace` task context, so a
+    cold compile shows up inside the triggering job's ``execute`` span —
+    plus ``runtime_compile_seconds_total{op}`` and per-op hit/miss counters.
+    The params store passes ``trace_label=None``: an HBM transfer is not a
+    compile and must not pollute the compile-cost series.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, trace_label: Optional[str] = "xla.compile") -> None:
         self._lock = threading.Lock()
         self._cache: Dict[Tuple[Hashable, ...], Any] = {}
         self._building: Dict[Tuple[Hashable, ...], threading.Event] = {}
         self._generation = 0  # bumped by clear(); fences in-flight builds
+        self._trace_label = trace_label
         self.hits = 0
         self.misses = 0
 
@@ -44,6 +56,8 @@ class ExecutableCache:
                 fn = self._cache.get(key)
                 if fn is not None:
                     self.hits += 1
+                    if self._trace_label:
+                        obs_trace.record_cache_event(key, hit=True)
                     return fn
                 ev = self._building.get(key)
                 if ev is None:
@@ -52,8 +66,15 @@ class ExecutableCache:
                     gen = self._generation
                     break
             ev.wait()  # someone else is compiling this key
+        if self._trace_label:
+            obs_trace.record_cache_event(key, hit=False)
         try:
+            t0 = time.perf_counter()
             fn = build()
+            if self._trace_label:
+                obs_trace.record_compile(
+                    key, time.perf_counter() - t0, name=self._trace_label
+                )
             with self._lock:
                 # A clear() that raced this build wins: return the value to
                 # the caller but do NOT cache it, so a post-clear store is

@@ -79,7 +79,9 @@ class TpuRuntime:
             jax.profiler.start_server(self.config.profile_port)
         self.mesh: Mesh = build_mesh(self.devices, self.config.mesh_shape)
         self.cache = ExecutableCache()
-        self._params = ExecutableCache()  # build-once dedup, same as executables
+        # Build-once dedup like executables, but NOT a compile: params
+        # builds are HBM transfers and stay out of the xla.compile series.
+        self._params = ExecutableCache(trace_label=None)
         self._model_ids: set = set()
         self._params_lock = threading.Lock()
         self._attention_fn = None

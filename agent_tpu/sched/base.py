@@ -85,12 +85,17 @@ class Scheduler:
     name = "?"
 
     def __init__(
-        self, on_decision: Optional[Callable[[str], None]] = None
+        self, on_decision: Optional[Callable[..., None]] = None
     ) -> None:
         # Counter hook (controller-provided): policy-internal decisions
         # (placement deferrals) surface in sched_decisions_total without the
-        # policy importing the metrics registry.
-        self.on_decision = on_decision or (lambda decision: None)
+        # policy importing the metrics registry. Policies that know WHICH
+        # job a decision concerns pass ``job_id=`` so the controller can
+        # also pin a span to that job's trace (ISSUE 5); hooks that ignore
+        # it must accept the kwarg.
+        self.on_decision = on_decision or (
+            lambda decision, **_kw: None
+        )
         self._depth_by_tenant: Dict[str, int] = {}
 
     # -- bookkeeping helpers for subclasses --

@@ -168,7 +168,9 @@ class FairScheduler(Scheduler):
         if job.placement_defers >= self.placement_patience:
             return True  # patience exhausted: any capable agent may take it
         job.placement_defers += 1
-        self.on_decision("deferred_placement")
+        # job_id lets the controller pin the deferral onto the job's trace
+        # (a sched.defer span) as well as the aggregate counter.
+        self.on_decision("deferred_placement", job_id=job.job_id)
         return False
 
     # ---- dispatch ----

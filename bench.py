@@ -955,6 +955,22 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
                     drain_ops,
                 )
                 span_s = {op: span_ms[op] / 1e3 for op in drain_ops}
+            # Slowest-job trace breakdown (ISSUE 5 satellite): fetched from
+            # GET /v1/trace/{job_id} so a regression in the trace path
+            # fails the bench loudly instead of rotting silently.
+            from agent_tpu.obs import trace as obs_trace
+            from agent_tpu.obs.scrape import slowest_trace
+            from agent_tpu.obs.trace import phase_breakdown
+
+            trace_line = None
+            if obs_trace.enabled():
+                worst = slowest_trace(server.url)
+                assert worst is not None, (
+                    "trace path broken: /v1/traces or /v1/trace/{job_id} "
+                    "returned nothing for a drained leg"
+                )
+                trace_line = phase_breakdown(worst)
+                print(f"[slowest shard] {trace_line}", flush=True)
             total_rows = n_rows + DRAIN_SUMMARIZE_ROWS
             mixed_leg = {
                 "rows_per_sec": round(total_rows / wall, 1),
@@ -963,6 +979,7 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
                 "classify_span_s": round(span_s["map_classify_tpu"], 2),
                 "summarize_span_s": round(span_s["map_summarize"], 2),
                 "span_source": span_source,
+                "slowest_trace": trace_line,
                 "wall_s": round(wall, 2),
                 "pipelined": True,
             }

@@ -278,6 +278,23 @@ def main() -> int:
             busy_ms = op_span_ms(ok_results, drain_ops)
             busy_s = {op: busy_ms[op] / 1e3 for op in drain_ops}
 
+        # Slowest-job trace (ISSUE 5 satellite): one line of per-phase
+        # attribution from GET /v1/trace/{job_id}. A broken trace path
+        # fails the drain loudly rather than rotting silently.
+        from agent_tpu.obs import trace as obs_trace
+        from agent_tpu.obs.scrape import slowest_trace
+        from agent_tpu.obs.trace import phase_breakdown
+
+        trace_line = None
+        if obs_trace.enabled():
+            worst = slowest_trace(server.url)
+            assert worst is not None, (
+                "trace path broken: /v1/traces or /v1/trace/{job_id} "
+                "returned nothing for a drained run"
+            )
+            trace_line = phase_breakdown(worst)
+            print(f"[slowest shard] {trace_line}", flush=True)
+
     report = {
         "rows": args.rows,
         "ops": ["map_classify_tpu", "map_summarize"],
@@ -300,6 +317,9 @@ def main() -> int:
         # (Renamed from the pre-deferred-fetch "device_busy_s" so old
         # reports aren't compared against a different quantity.)
         "span_source": span_source,
+        # Per-phase breakdown of the slowest job's assembled trace
+        # (GET /v1/trace/{job_id}); None only with TRACE_ENABLED=0.
+        "slowest_trace": trace_line,
         "classify": {
             "shard_size": CLASSIFY_SHARD,
             "rows_written": rows_written["map_classify_tpu"],
