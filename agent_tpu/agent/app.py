@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from agent_tpu.agent.spool import ResultSpool
 from agent_tpu.config import Config
+from agent_tpu.data import wire
 from agent_tpu.obs.metrics import MetricsRegistry
 from agent_tpu.obs.recorder import FlightRecorder
 from agent_tpu.obs.trace import (
@@ -201,6 +202,18 @@ class Agent:
         # ``capabilities`` so the controller's scheduler can steer bulk work
         # away from backed-up agents and shrink grants (ISSUE 4).
         self.staged_depth_fn: Optional[Any] = None
+        # Binary shard wire (ISSUE 6): the format the controller negotiated
+        # on the last granted lease (``wire: "b1"`` in the response body),
+        # None against a JSON-only controller. Read at op-context build time
+        # so finalize knows whether to emit binary result columns.
+        self.wire_format: Optional[str] = None
+        # Staging-pool grant ask (data/staging.py): when set, lease polls
+        # request max(MAX_TASKS, hint) tasks so N stage workers have work in
+        # flight; the controller's grant stays advisory downward.
+        self.lease_batch_hint: Optional[int] = None
+        # Poster-thread session override (PipelineRunner._post_loop):
+        # callable returning a session; None = a fresh requests.Session.
+        self.post_session_factory: Optional[Any] = None
 
     # ---- controller I/O ----
 
@@ -256,6 +269,11 @@ class Agent:
             "ops": sorted(self.handlers),
             "queue_depth": self._staged_depth(),
         }
+        if self.config.agent.wire_binary:
+            # Binary shard wire offer (ISSUE 6): a capable controller
+            # answers with ``wire: "b1"``; a legacy one ignores the key and
+            # the whole exchange stays plain JSON.
+            caps["wire_formats"] = list(wire.FORMATS)
         if self.runtime is not None:
             try:
                 desc = self.runtime.describe()
@@ -299,7 +317,14 @@ class Agent:
                 "/v1/leases",
                 {
                     "agent": a.agent_name,
-                    "capabilities": {"ops": []},
+                    # queue_depth sampled at request-BUILD time (ISSUE 6
+                    # satellite): the flush postdates the last real poll, so
+                    # without this the advertised depth would lag reality by
+                    # a whole poll cycle on every channel but the lease.
+                    "capabilities": {
+                        "ops": [],
+                        "queue_depth": self._staged_depth(),
+                    },
                     "max_tasks": 0,
                     "labels": a.labels,
                     "metrics": metrics,
@@ -410,12 +435,18 @@ class Agent:
             # Spans piggyback on the lease metrics channel (keyed by agent
             # like the obs snapshot); undelivered batches requeue below.
             metrics["spans"] = spans
+        # Staging-pool grant ask: never below the configured MAX_TASKS, and
+        # absent a pool hint exactly MAX_TASKS (the legacy wire).
+        hint = self.lease_batch_hint
+        max_tasks = (
+            a.max_tasks if hint is None else max(a.max_tasks, int(hint))
+        )
         status, body = self._post_json(
             "/v1/leases",
             {
                 "agent": a.agent_name,
                 "capabilities": self.capabilities(),
-                "max_tasks": a.max_tasks,
+                "max_tasks": max_tasks,
                 "timeout_ms": a.lease_timeout_ms,
                 "labels": a.labels,
                 "worker_profile": self.worker_profile(),
@@ -441,6 +472,11 @@ class Agent:
         if not isinstance(lease_id, str) or not isinstance(tasks, list):
             self.m_lease.inc(outcome="error")
             raise RuntimeError(f"malformed lease response: {str(body)[:200]}")
+        # Binary-wire negotiation (ISSUE 6): the controller stamps every
+        # granted lease it negotiated, so re-deriving here self-corrects if
+        # the controller changed its mind (e.g. restarted without binary).
+        fmt = body.get("wire")
+        self.wire_format = fmt if fmt in wire.FORMATS else None
         self.m_lease.inc(outcome="tasks")
         self.recorder.record(
             "lease", lease_id=lease_id, n_tasks=len(tasks),
@@ -627,9 +663,13 @@ class Agent:
         trace = {"job_id": job_id, "attempt": attempt, "lease_id": lease_id}
         if parent_span_id:
             trace["span_id"] = parent_span_id
+        tags: Dict[str, Any] = {"job_id": job_id, "trace": trace}
+        if self.wire_format:
+            # Negotiated wire format (ISSUE 6): finalize reads this to emit
+            # binary result columns instead of tolist()-ed JSON.
+            tags["wire"] = self.wire_format
         return OpContext(
-            runtime=self.runtime, config=self.config,
-            tags={"job_id": job_id, "trace": trace},
+            runtime=self.runtime, config=self.config, tags=tags,
         )
 
     def profiled_call(self, op: str, thunk: Any) -> Any:
@@ -665,6 +705,12 @@ class Agent:
         """
         try:
             job_id, op, payload, epoch = self.extract_task(task)
+            if wire.is_binary_payload(payload):
+                # Binary shard wire (ISSUE 6): the controller encoded the
+                # bulk columns; ops see the decoded plain payload. A
+                # malformed envelope raises ValueError and reports exactly
+                # like any other malformed task.
+                payload = wire.decode_task_payload(payload)
         except ValueError as exc:
             self.rate.log("task:bad", "malformed task", error=str(exc))
             jid = task.get("id") if isinstance(task, dict) else None

@@ -6,15 +6,21 @@ idles while the host stages and posts (the round-2 gap: drain < pure-op
 throughput). This runner overlaps them (BASELINE.json north star: "streams
 shards straight into HBM with host-side double buffering"):
 
-- **stager thread**: leases tasks and runs each op's ``stage`` phase (payload
-  validation, shard read, fused tokenize+pad → numpy) feeding a bounded
-  queue of depth ``pipeline_depth``; the bound is the backpressure that keeps
-  staging ~one shard ahead of the device instead of reading the whole
-  dataset into RAM.
+- **staging pool** (ISSUE 6, ``data/staging.py``): a feeder thread owns the
+  lease loop and N autotuned workers run op ``stage`` phases (payload
+  validation, shard read, fused tokenize+pad → numpy) *concurrently* into a
+  bounded queue of depth ``pipeline_depth`` (the autotuner may widen it);
+  the bound is the backpressure that keeps staging ~one shard ahead of the
+  device instead of reading the whole dataset into RAM. ``STAGE_WORKERS=1``
+  reproduces the old single-stager pipeline exactly.
 - **device (calling) thread**: pops staged work and runs the op's ``execute``
   phase — every device touch stays on this one thread, preserving the
   single-owner invariant the reference called the "TPU RULE" (reference
-  ``app.py:286``; SURVEY.md §5.2). No forks, no process pools.
+  ``app.py:286``; SURVEY.md §5.2). No forks, no process pools. With
+  ``FEED_DOUBLE_BUFFER`` (default on) it also *pre-feeds* the next staged
+  item's host→device transfer (``jax.device_put`` is async and this is the
+  owning thread) before dispatching the current item, so the device never
+  waits on a transfer between shards.
 - **poster thread**: runs ``finalize`` — which for the model ops also pays
   the deferred device→host result fetch (reading a ``jax.Array`` is
   thread-safe; only dispatch is owner-bound), then numpy → JSON shapes —
@@ -46,7 +52,6 @@ from typing import Any, Dict, Optional
 from agent_tpu.obs.trace import TraceContext, new_span_id, use_context
 from agent_tpu.utils.errors import structured_error
 from agent_tpu.utils.logging import log
-from agent_tpu.utils.retry import jittered
 
 
 @dataclass
@@ -84,14 +89,21 @@ SHUTDOWN_GRACE_SEC = 30.0
 
 
 class PipelineRunner:
-    """Owns the stager/poster threads around the caller's device loop.
+    """Owns the staging pool + poster thread around the caller's device loop.
 
     ``runner.run()`` blocks on the device loop until ``agent.running`` flips
     false (signal handler or test), then drains both queues so no leased task
     is dropped on shutdown — same graceful-drain contract as the serial loop.
     """
 
-    def __init__(self, agent, depth: int = 2) -> None:
+    def __init__(
+        self,
+        agent,
+        depth: int = 2,
+        workers: Optional[int] = None,
+        autotune: Optional[bool] = None,
+        double_buffer: Optional[bool] = None,
+    ) -> None:
         self.agent = agent
         self.depth = max(1, depth)
         self.staged_q: "queue.Queue" = queue.Queue(maxsize=self.depth)
@@ -100,21 +112,34 @@ class PipelineRunner:
         # in-flight shards — an unbounded post queue would pin device
         # output buffers without limit when the poster falls behind.
         self.post_q: "queue.Queue" = queue.Queue(maxsize=self.depth + 1)
-        # Live load advertisement (ISSUE 4): the stager's lease polls ship
-        # the CURRENT staged-queue occupancy in capabilities.queue_depth, so
-        # the controller's fair scheduler can shrink this agent's grants and
-        # steer bulk shards to idler agents while we're backed up. (The obs
-        # gauge lags a queue transition; the qsize read does not.)
-        agent.staged_depth_fn = self.staged_q.qsize
-        self.tasks_posted = 0
-        self._stager = threading.Thread(
-            target=self._stage_loop, name="agent-stager", daemon=True
+        # Staging pool (ISSUE 6): the feeder thread owns the lease loop and
+        # N autotuned workers run stage() concurrently; workers/autotune
+        # default from config (STAGE_WORKERS / STAGE_AUTOTUNE).
+        from agent_tpu.data.staging import StagingPool
+
+        self._pool = StagingPool(
+            agent, self.staged_q, self._stage_one, _STOP,
+            max_workers=workers, autotune=autotune, base_depth=self.depth,
         )
+        # Double-buffered device feed (FEED_DOUBLE_BUFFER): pre-issue the
+        # next item's host→device transfer while the current one executes.
+        self.double_buffer = (
+            agent.config.agent.feed_double_buffer
+            if double_buffer is None else bool(double_buffer)
+        )
+        # Live load advertisement (ISSUE 4): lease polls ship the CURRENT
+        # leased-but-unexecuted backlog (staged + queued-for-staging) in
+        # capabilities.queue_depth, so the controller's fair scheduler can
+        # shrink this agent's grants and steer bulk shards to idler agents
+        # while we're backed up. (The obs gauge lags a queue transition;
+        # the qsize read does not.)
+        agent.staged_depth_fn = self._pool.backlog
+        self.tasks_posted = 0
         self._poster = threading.Thread(
             target=self._post_loop, name="agent-poster", daemon=True
         )
 
-    # ---- stager thread ----
+    # ---- staging (run on the pool's worker threads) ----
 
     def _stage_one(self, lease_id: str, task: Any) -> Optional[_Item]:
         agent = self.agent
@@ -176,47 +201,6 @@ class PipelineRunner:
             item.staged = value
         return item
 
-    def _stage_loop(self) -> None:
-        agent = self.agent
-        try:
-            while agent.running:
-                try:
-                    leased = agent.lease_once()
-                except RuntimeError as exc:
-                    agent.rate.log("lease", str(exc))
-                    # Shared retry policy (utils/retry.py): decorrelated
-                    # jittered backoff instead of the old flat sleep.
-                    time.sleep(agent._lease_retry.next_backoff())
-                    continue
-                agent._lease_retry.reset()
-                if leased is None:
-                    time.sleep(jittered(agent.config.agent.idle_sleep_sec))
-                    continue
-                lease_id, tasks = leased
-                for task in tasks:
-                    if not agent.running:
-                        break
-                    item = self._stage_one(lease_id, task)
-                    if item is not None:
-                        self._put_bounded(item)  # blocks at depth; backpressure
-        finally:
-            # The sentinel must reach the device loop even if this thread
-            # dies unexpectedly — a lost sentinel would leave the device
-            # thread blocked in get() forever, a hung agent holding the TPU.
-            self.staged_q.put(_STOP)
-
-    def _put_bounded(self, item: Any) -> None:
-        """Blocking put that still notices shutdown: if the device loop died
-        with the queue full, a plain put() would deadlock the stager."""
-        while True:
-            try:
-                self.staged_q.put(item, timeout=0.5)
-                self.agent.m_queue.set(self.staged_q.qsize(), queue="staged")
-                return
-            except queue.Full:
-                if not self.agent.running:
-                    return  # drain aborted; lease TTL re-queues the task
-
     # ---- device (calling) thread ----
 
     def _put_post(self, item: Any) -> bool:
@@ -248,22 +232,79 @@ class PipelineRunner:
                 if waited >= SHUTDOWN_GRACE_SEC:
                     return False  # wedged poster during shutdown
 
+    def _prefeed(self, item: Any) -> None:
+        """Double-buffered device feed (ISSUE 6): start the NEXT item's
+        host→device transfer before the current item's execute dispatch.
+        ``jax.device_put`` is async and this is the owning thread, so the
+        transfer overlaps the in-flight compute and the op's own
+        ``put_batch`` later passes the already-placed arrays through without
+        a copy. Only the well-known staged-chunk layout
+        (``state["chunks"] = [(ids, lengths, n), …]`` of numpy arrays) is
+        pre-fed; anything else stays untouched — this is purely an
+        optimization and must never fail an item."""
+        import numpy as np
+
+        runtime = self.agent.runtime
+        if (
+            runtime is None or item.monolithic or item.staged is None
+            or item.result is not None or item.status == "failed"
+        ):
+            return
+        state = item.staged
+        chunks = state.get("chunks") if isinstance(state, dict) else None
+        if not isinstance(chunks, list):
+            return
+        try:
+            fed = []
+            for chunk in chunks:
+                if (
+                    isinstance(chunk, (tuple, list)) and len(chunk) == 3
+                    and isinstance(chunk[0], np.ndarray)
+                    and isinstance(chunk[1], np.ndarray)
+                ):
+                    fed.append((
+                        runtime.put_batch(chunk[0]),
+                        runtime.put_batch(chunk[1]),
+                        chunk[2],
+                    ))
+                else:
+                    fed.append(chunk)
+            state["chunks"] = fed
+        except Exception:  # noqa: BLE001 — the op re-puts on execute anyway
+            pass
+
     def _execute_loop(self) -> None:
         agent = self.agent
+        pending: Any = None
         try:
             while True:
-                # Busy/idle attribution (the tf.data question — is the input
-                # stage or the accelerator the limiter?): time blocked here
-                # is device idle; time inside the op dispatch is device busy.
-                t_wait = time.perf_counter()
-                item = self.staged_q.get()
-                agent.m_device_idle.inc(time.perf_counter() - t_wait)
+                if pending is not None:
+                    item, pending = pending, None
+                else:
+                    # Busy/idle attribution (the tf.data question — is the
+                    # input stage or the accelerator the limiter?): time
+                    # blocked here is device idle; time inside the op
+                    # dispatch is device busy.
+                    t_wait = time.perf_counter()
+                    item = self.staged_q.get()
+                    agent.m_device_idle.inc(time.perf_counter() - t_wait)
                 if item is _STOP:
                     break
                 agent.m_queue.set(self.staged_q.qsize(), queue="staged")
                 if item.result is not None or item.status == "failed":
                     self._put_post(item)
                     continue
+                if self.double_buffer:
+                    # Peek-ahead: grab the next staged item (if any) and
+                    # issue its transfers now, so they run under the current
+                    # item's execute. The popped item is held locally and
+                    # consumed on the next loop iteration — never lost.
+                    try:
+                        pending = self.staged_q.get_nowait()
+                    except queue.Empty:
+                        pending = None
+                    if pending is not None and pending is not _STOP:
+                        self._prefeed(pending)
                 t_exec = time.perf_counter()
                 if item.t_staged:
                     # Time spent waiting in the staged queue — the
@@ -332,14 +373,20 @@ class PipelineRunner:
     def _post_loop(self) -> None:
         agent = self.agent
         # Own HTTP session: requests.Session is not thread-safe, and the
-        # stager is concurrently POSTing leases on the agent's session.
+        # feeder is concurrently POSTing leases on the agent's session.
+        # ``post_session_factory`` overrides (bench wire-byte counting,
+        # loopback soaks) — it must return a session safe for THIS thread.
         session = None
-        try:
-            import requests
+        factory = getattr(agent, "post_session_factory", None)
+        if factory is not None:
+            session = factory()
+        else:
+            try:
+                import requests
 
-            session = requests.Session()
-        except Exception:  # noqa: BLE001 — stub sessions in tests
-            pass
+                session = requests.Session()
+            except Exception:  # noqa: BLE001 — stub sessions in tests
+                pass
         while True:
             item = self.post_q.get()
             if item is _STOP:
@@ -432,14 +479,19 @@ class PipelineRunner:
             from agent_tpu.runtime.runtime import get_runtime
 
             self.agent.runtime = get_runtime(self.agent.config.device)
-        log("pipelined drain up", depth=self.depth)
-        self._stager.start()
+        log(
+            "pipelined drain up", depth=self.depth,
+            stage_workers=self._pool.max_workers,
+            autotune=self._pool.autotune,
+            double_buffer=self.double_buffer,
+        )
+        self._pool.start()
         self._poster.start()
         try:
             self._execute_loop()   # device work stays on the caller's thread
         finally:
             self.agent.running = False
-            self._stager.join(timeout=30)
+            self._pool.join(timeout=30)
             self._poster.join(timeout=30)
             # Final telemetry flush (metrics-only lease): the last shard's
             # finalize postdates the stager's last real poll, so without

@@ -114,6 +114,19 @@ class AgentConfig:
     # queue between the stager thread and the device loop. 0 = serial loop.
     # Single-host only; multi-host lockstep broadcast stays serial.
     pipeline_depth: int = 2
+    # Data plane (ISSUE 6). Staging-pool worker count: 0 = auto
+    # (min(4, cpu_count)); 1 reproduces the single-stager pipeline.
+    stage_workers: int = 0                    # STAGE_WORKERS
+    # Autotune the staging parallelism + prefetch depth from the live
+    # task_phase_seconds{phase=stage}/{phase=execute} ratio.
+    stage_autotune: bool = True               # STAGE_AUTOTUNE
+    # Double-buffered device feed: the next staged item's host→device
+    # transfer is issued (async) before the current item executes.
+    feed_double_buffer: bool = True           # FEED_DOUBLE_BUFFER
+    # Advertise the compact binary shard wire (data/wire.py) in lease
+    # capabilities; a controller that negotiates it gets binary-encoded
+    # result columns (and may binary-encode task payloads).
+    wire_binary: bool = True                  # WIRE_BINARY
     # Fault tolerance (ISSUE 3). Backoff for lease errors and result
     # redelivery: capped exponential with decorrelated jitter
     # (utils/retry.py); error_backoff_sec above is kept as the legacy name
@@ -144,6 +157,10 @@ class AgentConfig:
             labels=parse_labels(os.environ.get("AGENT_LABELS", "")),
             tpu_kind=env_str("TPU_KIND", "tpu-v5e"),
             pipeline_depth=max(0, env_int("PIPELINE_DEPTH", 2)),
+            stage_workers=max(0, env_int("STAGE_WORKERS", 0)),
+            stage_autotune=env_bool("STAGE_AUTOTUNE", True),
+            feed_double_buffer=env_bool("FEED_DOUBLE_BUFFER", True),
+            wire_binary=env_bool("WIRE_BINARY", True),
             retry_base_sec=env_float("RETRY_BASE_SEC", 0.5),
             retry_max_sec=env_float("RETRY_MAX_SEC", 30.0),
             retry_deadline_sec=env_float("RETRY_DEADLINE_SEC", 0.0),

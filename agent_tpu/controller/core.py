@@ -62,6 +62,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from agent_tpu.config import TRUTHY_TOKENS, SchedConfig
+from agent_tpu.data import wire
 from agent_tpu.obs.metrics import (
     MetricsRegistry,
     histogram_quantile,
@@ -196,8 +197,13 @@ class Controller:
         requeue_delay_sec: float = 0.0,
         sched: Optional[SchedConfig] = None,
         trace_store: Optional[TraceStore] = None,
+        wire_binary: bool = True,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
+        # Binary shard wire (ISSUE 6): False = never negotiate (a JSON-only
+        # controller for compatibility tests and WIRE_BINARY=0 operators);
+        # agents that don't advertise are unaffected either way.
+        self.wire_binary = bool(wire_binary)
         self.max_attempts = max(1, int(max_attempts))
         self.requeue_delay_sec = max(0.0, float(requeue_delay_sec))
         self.sched_config = sched if sched is not None else SchedConfig()
@@ -286,6 +292,18 @@ class Controller:
             "controller_jobs_deadline_expired_total",
             "Pending jobs that ran out of deadline_sec (terminal `dead`, "
             "reason DeadlineExceeded)", ("op",))
+        # Data-plane wire accounting (ISSUE 6): envelopes encoded/decoded
+        # and raw HTTP bytes per route+direction (fed by server.py from
+        # Content-Length / response sizes — real wire bytes, not estimates;
+        # bench derives bytes/row from the scrape delta).
+        self._m_wire = m.counter(
+            "controller_wire_total",
+            "Binary-wire envelopes by direction (task=encoded task "
+            "payloads, result=decoded results, result_error=undecodable)",
+            ("direction", "format"))
+        self._m_http_bytes = m.counter(
+            "controller_http_bytes_total",
+            "HTTP bytes on the data-plane routes", ("route", "direction"))
         # The policy object every lease decision delegates to (ISSUE 4).
         self._sched = make_scheduler(
             self.sched_config, on_decision=self._on_sched_decision
@@ -998,6 +1016,16 @@ class Controller:
         caps = capabilities or {}
         ops = set(caps.get("ops") or [])
         labels = labels or {}
+        # Binary-wire negotiation (ISSUE 6): both sides must opt in — the
+        # agent by advertising, this controller by configuration. Old
+        # agents never advertise, so they keep byte-identical JSON.
+        adv = caps.get("wire_formats")
+        wire_fmt = (
+            wire.FORMAT
+            if self.wire_binary and isinstance(adv, (list, tuple))
+            and wire.FORMAT in adv
+            else None
+        )
         with self._lock:
             now_wall = time.time()
             if metrics:
@@ -1145,11 +1173,21 @@ class Controller:
                         for d in job.after_order
                         if d in self._jobs
                     ]
-                tasks.append(job.to_task())
+                def out_task(j: Job = job) -> Dict[str, Any]:
+                    task = j.to_task()
+                    if wire_fmt and wire.encodable_task(j.op, j.payload):
+                        # Bulk ``texts`` columns ship binary to a
+                        # negotiated agent; the job's own payload (journal,
+                        # replay, /v1/jobs) stays plain JSON.
+                        task["payload"] = wire.encode_task_payload(j.payload)
+                        self._m_wire.inc(direction="task", format=wire_fmt)
+                    return task
+
+                tasks.append(out_task())
                 if duplicate:
                     # Same task handed out twice under one lease: the
                     # second completion must be idempotent/fenced.
-                    tasks.append(job.to_task())
+                    tasks.append(out_task())
                     duplicate = False
                     self._m_faults.inc(fault="duplicate_task")
                     self.recorder.record(
@@ -1169,7 +1207,13 @@ class Controller:
                 self._m_lease.inc(outcome="idle")
                 return None
             self._m_lease.inc(outcome="granted")
-            return {"lease_id": lease_id, "tasks": tasks}
+            out = {"lease_id": lease_id, "tasks": tasks}
+            if wire_fmt:
+                # The negotiation answer: the agent may now binary-encode
+                # its result columns. Stamped on every negotiated grant so
+                # agents self-correct against a reconfigured controller.
+                out["wire"] = wire_fmt
+            return out
 
     def report(
         self,
@@ -1189,6 +1233,23 @@ class Controller:
         execution still happened and belongs on the timeline)."""
         if spans:
             self.traces.ingest(spans)
+        if wire.is_binary_result(result):
+            # Binary shard wire (ISSUE 6): decode OUTSIDE the lock (zlib +
+            # numpy work) so the hot path holds it no longer than a JSON
+            # result would. The stored result is exactly what a JSON-wire
+            # agent would have posted — downstream consumers (journal
+            # partials, /v1/jobs, reduce stages) never see the envelope.
+            try:
+                result = wire.decode_result(result)
+                self._m_wire.inc(direction="result", format=wire.FORMAT)
+            except ValueError as exc:
+                # Undecodable envelope: keep the raw body (debuggable, not
+                # silently dropped) and make the corruption visible.
+                self._m_wire.inc(
+                    direction="result_error", format=wire.FORMAT
+                )
+                log("binary result envelope undecodable", job_id=job_id,
+                    error=str(exc)[:200])
         with self._lock:
             job = self._jobs.get(job_id)
             if job is None:
@@ -1325,6 +1386,13 @@ class Controller:
                 }
             )
             return {"accepted": True}
+
+    def note_http_bytes(self, route: str, direction: str, n: int) -> None:
+        """Raw data-plane byte accounting, fed by the HTTP layer (request
+        Content-Length in, response body bytes out) — what bench's
+        ``drain_binary_wire`` leg derives wire bytes/row from."""
+        if n > 0:
+            self._m_http_bytes.inc(int(n), route=route, direction=direction)
 
     # ---- introspection (for tests, bench, and a future status endpoint) ----
 

@@ -26,24 +26,27 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _read_json(self) -> Optional[Dict[str, Any]]:
+        self._request_bytes = 0
         try:
             length = int(self.headers.get("Content-Length", "0"))
             raw = self.rfile.read(length) if length else b"{}"
+            self._request_bytes = length
             body = json.loads(raw or b"{}")
         except (ValueError, OSError):
             return None
         return body if isinstance(body, dict) else None
 
-    def _send(self, status: int, body: Optional[Dict[str, Any]] = None) -> None:
+    def _send(self, status: int, body: Optional[Dict[str, Any]] = None) -> int:
         self.send_response(status)
         if body is None:
             self.end_headers()
-            return
+            return 0
         data = json.dumps(body, default=str).encode()
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+        return len(data)
 
     def _send_text(self, status: int, text: str, content_type: str) -> None:
         data = text.encode("utf-8")
@@ -78,9 +81,14 @@ class _Handler(BaseHTTPRequestHandler):
                 else None,
             )
             if lease is None:
-                self._send(204)
+                n_out = self._send(204)
             else:
-                self._send(200, lease)
+                n_out = self._send(200, lease)
+            # Data-plane byte accounting (ISSUE 6): task bodies leave on
+            # lease responses — real wire bytes, straight off this socket.
+            self.controller.note_http_bytes("/v1/leases", "in",
+                                            self._request_bytes)
+            self.controller.note_http_bytes("/v1/leases", "out", n_out)
         elif self.path == "/v1/results":
             out = self.controller.report(
                 lease_id=str(body.get("lease_id", "")),
@@ -93,7 +101,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # legacy agents.
                 spans=body.get("spans"),
             )
-            self._send(200, out)
+            n_out = self._send(200, out)
+            # Result bodies arrive on this route — the other half of the
+            # wire-bytes/row arithmetic bench's binary-wire leg reports.
+            self.controller.note_http_bytes("/v1/results", "in",
+                                            self._request_bytes)
+            self.controller.note_http_bytes("/v1/results", "out", n_out)
         elif self.path == "/v1/jobs":
             # Operator surface: submit one job or a sharded CSV job.
             try:
@@ -311,12 +324,19 @@ def main() -> int:
     agent_tpu.controller.server``. Env: CONTROLLER_HOST (default 0.0.0.0),
     CONTROLLER_PORT (default 8080), LEASE_TTL_SEC (default 30),
     MAX_ATTEMPTS (default retry budget, 2), REQUEUE_DELAY_SEC (retried jobs
-    held back this long, default 1), plus the SCHED_* scheduler knobs
+    held back this long, default 1), WIRE_BINARY (0 disables the binary
+    shard wire; default on), plus the SCHED_* scheduler knobs
     (SCHED_POLICY fifo|fair, SCHED_MAX_PENDING[_PER_TENANT],
     SCHED_TENANT_WEIGHTS, … — see config.SchedConfig)."""
     import signal
 
-    from agent_tpu.config import SchedConfig, env_float, env_int, env_str
+    from agent_tpu.config import (
+        SchedConfig,
+        env_bool,
+        env_float,
+        env_int,
+        env_str,
+    )
 
     host = env_str("CONTROLLER_HOST", "0.0.0.0")
     port = env_int("CONTROLLER_PORT", 8080)
@@ -331,6 +351,9 @@ def main() -> int:
         max_attempts=max(1, env_int("MAX_ATTEMPTS", 2)),
         requeue_delay_sec=env_float("REQUEUE_DELAY_SEC", 1.0),
         sched=sched,
+        # WIRE_BINARY=0 runs a JSON-only controller (binary-capable agents
+        # simply never get the `wire` answer and stay on JSON).
+        wire_binary=env_bool("WIRE_BINARY", True),
     )
     server = ControllerServer(controller, host=host, port=port)
     stop = threading.Event()

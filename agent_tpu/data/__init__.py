@@ -1,4 +1,5 @@
-"""Data plane: byte-offset CSV sharding and host→device prefetch.
+"""Data plane: byte-offset CSV sharding, the parallel autotuned staging
+pool (``staging.py``), and the compact binary shard wire (``wire.py``).
 
 Successor of the reference's skip-scan CSV reader (reference
 ``ops/csv_shard.py:9-26``), which re-reads every row before ``start_row`` on

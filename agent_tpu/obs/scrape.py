@@ -102,3 +102,93 @@ def slowest_trace(
         return None
     worst = max(candidates, key=lambda t: t["root_duration_ms"])
     return fetch_trace(base_url, worst["trace_id"], timeout=timeout)
+
+
+# ---- stage/execute overlap (ISSUE 6 satellite) ----
+
+def _merge_intervals(ivals):
+    """Sorted-union of (t0, t1) wall intervals."""
+    merged = []
+    for t0, t1 in sorted(ivals):
+        if merged and t0 <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], t1))
+        else:
+            merged.append((t0, t1))
+    return merged
+
+
+def _overlap_seconds(ival, merged):
+    t0, t1 = ival
+    total = 0.0
+    for m0, m1 in merged:
+        lo, hi = max(t0, m0), min(t1, m1)
+        if hi > lo:
+            total += hi - lo
+        if m0 >= t1:
+            break
+    return total
+
+
+def overlap_from_spans(spans) -> Optional[Dict[str, Any]]:
+    """Cross-job stage/execute concurrency from assembled trace spans: the
+    fraction of stage wall time hidden under SOME execute span (across
+    jobs — pipelining hides job B's staging behind job A's execute), plus
+    per-phase p50s. The acceptance picture of the staging pool: overlap →
+    1.0 and stage p50 ≤ execute p50 mean staging is invisible behind the
+    device. None when no closed stage/execute spans exist."""
+    stage, execute = [], []
+    for span in spans:
+        if not isinstance(span, dict):
+            continue
+        dur = span.get("duration_ms")
+        start = span.get("start_wall")
+        if not isinstance(dur, (int, float)) or \
+                not isinstance(start, (int, float)):
+            continue
+        ival = (float(start), float(start) + float(dur) / 1e3)
+        if span.get("name") == "stage":
+            stage.append(ival)
+        elif span.get("name") == "execute":
+            execute.append(ival)
+    if not stage or not execute:
+        return None
+    merged = _merge_intervals(execute)
+    stage_total = sum(t1 - t0 for t0, t1 in stage)
+    hidden = sum(_overlap_seconds(iv, merged) for iv in stage)
+
+    def p50_ms(ivals):
+        durs = sorted((t1 - t0) * 1e3 for t0, t1 in ivals)
+        return durs[len(durs) // 2]
+
+    return {
+        "overlap_ratio": round(hidden / stage_total, 4) if stage_total else 1.0,
+        "stage_total_s": round(stage_total, 3),
+        "execute_total_s": round(
+            sum(t1 - t0 for t0, t1 in execute), 3
+        ),
+        "stage_p50_ms": round(p50_ms(stage), 3),
+        "execute_p50_ms": round(p50_ms(execute), 3),
+        "n_stage_spans": len(stage),
+        "n_execute_spans": len(execute),
+    }
+
+
+def stage_execute_overlap(
+    base_url: str, limit: int = 64, timeout: float = 10.0
+) -> Optional[Dict[str, Any]]:
+    """:func:`overlap_from_spans` over the controller's newest ``limit``
+    traces (``/v1/traces`` + per-job ``/v1/trace/{id}``). None when the
+    trace path is down or no stage/execute spans assembled — callers that
+    promised the breakdown (drain_at_scale) must fail loudly on None."""
+    listing = fetch_json(base_url, f"/v1/traces?limit={int(limit)}",
+                         timeout=timeout)
+    if not isinstance(listing, dict):
+        return None
+    spans = []
+    for entry in listing.get("traces", []):
+        if not isinstance(entry, dict) or not entry.get("trace_id"):
+            continue
+        assembled = fetch_trace(base_url, entry["trace_id"], timeout=timeout)
+        if assembled:
+            spans.extend(assembled.get("spans", []))
+    return overlap_from_spans(spans)

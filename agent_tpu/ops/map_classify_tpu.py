@@ -699,6 +699,20 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
         return out
 
     if result_format == "columnar":
+        if ctx is not None and hasattr(ctx, "tags") \
+                and ctx.tags.get("wire") == "b1":
+            # Binary shard wire (ISSUE 6): ship the [N, k] columns as raw
+            # arrays (indices width-shrunk, scores as the rounded f32 bit
+            # patterns) instead of tolist()-ing them into JSON — the
+            # controller decodes to the exact lists the JSON path would
+            # have produced (same np.round(…, 6) then-widen semantics), so
+            # binary and JSON drains are bit-identical.
+            from agent_tpu.data import wire
+
+            return wire.attach_result_columns(out, {
+                "indices": np.ascontiguousarray(idx),
+                "scores": np.round(np.asarray(vals), 6),
+            })
         # Drain-friendly wire shape: [N, k] index/score arrays instead of
         # 5·N score dicts — ~3× smaller JSON and ~4× faster to serialize,
         # which is real money when results travel per-shard over HTTP.

@@ -548,6 +548,16 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
         return out
     out["summary"] = summaries[0]
     if not state["single"]:
+        if ctx is not None and hasattr(ctx, "tags") \
+                and ctx.tags.get("wire") == "b1":
+            # Binary shard wire (ISSUE 6): the summaries column is the bulk
+            # of a drain result body — ship it length-prefixed + deflated
+            # (repetitive summaries compress hard) instead of as escaped
+            # JSON strings. The controller decodes back to the identical
+            # ``summaries`` list.
+            from agent_tpu.data import wire
+
+            return wire.attach_result_columns(out, {"summaries": summaries})
         out["summaries"] = summaries
     return out
 

@@ -790,9 +790,12 @@ def _bench_csv_index(tmpdir: str, n_rows: int = 1_000_000, repeats: int = 3):
     return best
 
 
-def _drain_until_done(agent, controller, depth: int = 2) -> float:
+def _drain_until_done(agent, controller, depth: int = 2, workers=None,
+                      autotune=None, double_buffer=None) -> float:
     """Run the pipelined runner until the controller drains; returns the wall
-    seconds to the drain moment (not thread-teardown time)."""
+    seconds to the drain moment (not thread-teardown time). ``workers``/
+    ``autotune``/``double_buffer`` override the staging-pool config
+    (ISSUE 6); None keeps the STAGE_* defaults."""
     from agent_tpu.agent.pipeline import PipelineRunner
 
     agent.running = True
@@ -807,7 +810,8 @@ def _drain_until_done(agent, controller, depth: int = 2) -> float:
     watcher = threading.Thread(target=watch, daemon=True)
     t0 = time.perf_counter()
     watcher.start()
-    PipelineRunner(agent, depth=depth).run()
+    PipelineRunner(agent, depth=depth, workers=workers, autotune=autotune,
+                   double_buffer=double_buffer).run()
     watcher.join(timeout=10)
     return done.get("wall", time.perf_counter() - t0)
 
@@ -986,6 +990,155 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
     return classify_leg, mixed_leg
 
 
+def _drain_harness(runtime, n_rows, extra, td, wire_binary=True):
+    """(controller, server, agent, csv_path) for one drain leg — shared by
+    the staged-parallel and binary-wire legs (ISSUE 6)."""
+    import requests
+
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.config import AgentConfig, Config
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+
+    path = os.path.join(td, "drain.csv")
+    if not os.path.exists(path):
+        with open(path, "w") as f:
+            f.write("id,text,risk\n")
+            for i in range(n_rows):
+                f.write(
+                    f'{i},"drain record {i} with a payload of text",{i % 89}\n'
+                )
+    controller = Controller(lease_ttl_sec=600.0, wire_binary=wire_binary)
+    server = ControllerServer(controller).start()
+    cfg = Config(agent=AgentConfig(
+        controller_url=server.url, agent_name="bench-drain-dp",
+        tasks=("map_classify_tpu",), idle_sleep_sec=0.0,
+    ))
+    agent = Agent(config=cfg, session=requests.Session(), runtime=runtime)
+    agent._profile = {"tier": "bench"}
+    return controller, server, agent, path
+
+
+def _scrape_http_bytes(url):
+    """{(route, direction): bytes} from controller_http_bytes_total."""
+    from agent_tpu.obs.metrics import parse_exposition
+    from agent_tpu.obs.scrape import fetch_metrics_text
+
+    text = fetch_metrics_text(url)
+    out = {}
+    if text is None:
+        return out
+    try:
+        samples = parse_exposition(text)
+    except ValueError:
+        return out
+    for labels, value in samples.get("controller_http_bytes_total", []):
+        out[(labels.get("route"), labels.get("direction"))] = value
+    return out
+
+
+def _bench_drain_staged(runtime, n_rows: int = DRAIN_ROWS,
+                        shard_size: int = DRAIN_SHARD_SIZE):
+    """``drain_staged_parallel`` leg (ISSUE 6): the classify drain with the
+    staging pool at 4 autotuned workers + double-buffered feed vs the
+    single-worker reference — same rows, bit-identical results asserted."""
+    import tempfile
+
+    extra = {"text_field": "text", "allow_fallback": False,
+             "result_format": "columnar"}
+    leg = {"rows": n_rows}
+    with tempfile.TemporaryDirectory() as td:
+        results = {}
+        for key, workers, autotune in (("workers_1", 1, False),
+                                       ("workers_4", 4, True)):
+            controller, server, agent, path = _drain_harness(
+                runtime, n_rows, extra, td
+            )
+            try:
+                # Warm outside the timed window (compile is per-process).
+                controller.submit_csv_job(
+                    path, total_rows=shard_size, shard_size=shard_size,
+                    map_op="map_classify_tpu", extra_payload=extra,
+                )
+                _drain_until_done(agent, controller, workers=workers,
+                                  autotune=autotune)
+                warm_jobs = set(controller.results())
+                controller.submit_csv_job(
+                    path, total_rows=n_rows, shard_size=shard_size,
+                    map_op="map_classify_tpu", extra_payload=extra,
+                )
+                wall = _drain_until_done(agent, controller, workers=workers,
+                                         autotune=autotune)
+                counts = controller.counts()
+                assert counts.get("failed", 0) == 0, counts
+                leg[f"{key}_rows_per_sec"] = round(n_rows / wall, 1)
+                results[key] = {
+                    controller.job(j).payload["start_row"]:
+                        (r["indices"], r["scores"])
+                    for j, r in controller.results().items()
+                    if j not in warm_jobs
+                }
+            finally:
+                server.stop()
+        assert results["workers_1"] == results["workers_4"], (
+            "multi-worker staging diverged from the single-worker reference"
+        )
+        leg["bit_identical"] = True
+        leg["speedup"] = round(
+            leg["workers_4_rows_per_sec"] / leg["workers_1_rows_per_sec"], 3
+        )
+        leg["rows_per_sec"] = leg["workers_4_rows_per_sec"]
+    return leg
+
+
+def _bench_drain_binary(runtime, n_rows: int = DRAIN_ROWS,
+                        shard_size: int = DRAIN_SHARD_SIZE):
+    """``drain_binary_wire`` leg (ISSUE 6): the classify drain over real
+    HTTP with the binary shard wire negotiated vs a JSON-only controller —
+    rows/sec plus REAL wire bytes/row (server-side Content-Length deltas on
+    /v1/leases out + /v1/results in) and the shrink factor."""
+    import tempfile
+
+    extra = {"text_field": "text", "allow_fallback": False,
+             "result_format": "columnar"}
+    leg = {"rows": n_rows}
+    with tempfile.TemporaryDirectory() as td:
+        for key, wire_binary in (("json", False), ("b1", True)):
+            controller, server, agent, path = _drain_harness(
+                runtime, n_rows, extra, td, wire_binary=wire_binary
+            )
+            try:
+                controller.submit_csv_job(
+                    path, total_rows=shard_size, shard_size=shard_size,
+                    map_op="map_classify_tpu", extra_payload=extra,
+                )
+                _drain_until_done(agent, controller)
+                pre = _scrape_http_bytes(server.url)
+                controller.submit_csv_job(
+                    path, total_rows=n_rows, shard_size=shard_size,
+                    map_op="map_classify_tpu", extra_payload=extra,
+                )
+                wall = _drain_until_done(agent, controller)
+                counts = controller.counts()
+                assert counts.get("failed", 0) == 0, counts
+                post = _scrape_http_bytes(server.url)
+                data_bytes = sum(
+                    post.get(k, 0.0) - pre.get(k, 0.0)
+                    for k in (("/v1/results", "in"), ("/v1/leases", "out"))
+                )
+                leg[f"{key}_rows_per_sec"] = round(n_rows / wall, 1)
+                leg[f"{key}_bytes_per_row"] = round(data_bytes / n_rows, 1)
+            finally:
+                server.stop()
+        if leg.get("b1_bytes_per_row"):
+            leg["wire_shrink_x"] = round(
+                leg["json_bytes_per_row"] / leg["b1_bytes_per_row"], 2
+            )
+        leg["rows_per_sec"] = leg["b1_rows_per_sec"]
+        leg["bytes_per_row"] = leg["b1_bytes_per_row"]
+    return leg
+
+
 def main() -> int:
     from agent_tpu.runtime.runtime import get_runtime
 
@@ -1051,6 +1204,18 @@ def main() -> int:
     except Exception as exc:  # noqa: BLE001 — an AssertionError here means
         # shards FAILED — a correctness signal, not an environment quirk.
         legs["drain"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+
+    # Data-plane legs (ISSUE 6): staging-pool parallelism and the binary
+    # shard wire, both against the same classify drain shape as `drain`.
+    for name, fn in (
+        ("drain_staged_parallel", lambda: _bench_drain_staged(runtime)),
+        ("drain_binary_wire", lambda: _bench_drain_binary(runtime)),
+    ):
+        try:
+            legs[name] = fn()
+        except Exception as exc:  # noqa: BLE001 — bit-identity assertion
+            # failures must surface in the artifact, not kill the line.
+            legs[name] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
     baseline = 10_000.0  # BASELINE.md north star: ≥10k rows/sec/chip
     print(
@@ -1125,6 +1290,12 @@ def main() -> int:
                 "flash_vs_dense_8k": legs["long_ctx"].get("flash_vs_dense_8k"),
                 "csv_index_mb_per_sec": legs["csv_index"].get("mb_per_sec"),
                 "e2e_drain_rows_per_sec": legs["drain"].get("rows_per_sec"),
+                "drain_staged_rows_per_sec": legs["drain_staged_parallel"]
+                .get("rows_per_sec"),
+                "wire_bytes_per_row": legs["drain_binary_wire"]
+                .get("bytes_per_row"),
+                "wire_shrink_x": legs["drain_binary_wire"]
+                .get("wire_shrink_x"),
             }
         ),
         flush=True,

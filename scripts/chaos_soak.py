@@ -68,11 +68,18 @@ def make_agent(
     plan: Optional[FaultPlan] = None,
     max_tasks: int = 2,
 ) -> Agent:
+    from agent_tpu.config import env_bool, env_int
+
     cfg = Config(agent=AgentConfig(
         controller_url="http://loopback", agent_name=name,
         tasks=("risk_accumulate",), max_tasks=max_tasks,
         idle_sleep_sec=0.0, error_backoff_sec=0.0,
         retry_base_sec=0.001, retry_max_sec=0.01,
+        # --pipeline mode honors the data-plane env knobs (the config here
+        # is built directly, so from_env() never runs for soak agents).
+        stage_workers=max(0, env_int("STAGE_WORKERS", 0)),
+        stage_autotune=env_bool("STAGE_AUTOTUNE", True),
+        feed_double_buffer=env_bool("FEED_DOUBLE_BUFFER", True),
     ))
     registry = MetricsRegistry()
     session: Any = LoopbackSession(controller)
@@ -98,11 +105,46 @@ def submit_job(
     return shard_ids, reduce_id
 
 
+def drive_drain_pipelined(
+    controller: Controller,
+    agent: Agent,
+    deadline_sec: float,
+) -> Tuple[List[Agent], int, bool]:
+    """ISSUE 6: drive ONE agent through the real ``PipelineRunner`` — the
+    staging pool (STAGE_WORKERS/STAGE_AUTOTUNE honored via config) + the
+    double-buffered feed — instead of the serial step loop. Crash-restart
+    injection is a step-loop construct and is not consulted here (the plan
+    simply never decides ``agent_crash``, so the fault accounting stays
+    consistent). Same return shape as :func:`drive_drain`."""
+    import threading
+
+    from agent_tpu.agent.pipeline import PipelineRunner
+
+    # The poster thread must post through the SAME loopback/chaos session
+    # the lease loop uses (its default — a fresh requests.Session — would
+    # try to reach the fake URL over the network).
+    agent.post_session_factory = lambda: agent.session
+    agent.running = True
+    deadline = time.monotonic() + deadline_sec
+
+    def watch() -> None:
+        while not controller.drained() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        agent.running = False
+
+    watcher = threading.Thread(target=watch, daemon=True)
+    watcher.start()
+    PipelineRunner(agent, depth=2).run()
+    watcher.join(timeout=10)
+    return [agent], 0, controller.drained()
+
+
 def drive_drain(
     controller: Controller,
     agents: List[Agent],
     plan: Optional[FaultPlan],
     deadline_sec: float,
+    pipeline: bool = False,
 ) -> Tuple[List[Agent], int, bool]:
     """Step the agents until the controller drains (or the deadline hits).
 
@@ -110,6 +152,8 @@ def drive_drain(
     agent with a fresh incarnation (same registry — counters continue): the
     crash-restart-mid-lease fault. Returns (final agents, crashes, drained).
     """
+    if pipeline:
+        return drive_drain_pipelined(controller, agents[0], deadline_sec)
     crashes = 0
     deadline = time.monotonic() + deadline_sec
     while not controller.drained() and time.monotonic() < deadline:
@@ -160,12 +204,14 @@ def counter_total(registry: MetricsRegistry, name: str,
 
 
 def run_reference(csv_path: str, shards: int, rows_per_shard: int,
-                  deadline_sec: float) -> Tuple[str, List[str]]:
+                  deadline_sec: float,
+                  pipeline: bool = False) -> Tuple[str, List[str]]:
     problems: List[str] = []
     controller = Controller(lease_ttl_sec=30.0)
     _, reduce_id = submit_job(controller, csv_path, shards, rows_per_shard)
     agents = [make_agent(controller, "ref-agent")]
-    _, _, drained = drive_drain(controller, agents, None, deadline_sec)
+    _, _, drained = drive_drain(controller, agents, None, deadline_sec,
+                                pipeline=pipeline)
     if not drained:
         problems.append("reference drain did not complete")
         return "", problems
@@ -179,9 +225,11 @@ def run_reference(csv_path: str, shards: int, rows_per_shard: int,
 def run_chaos(
     seed: int, csv_path: str, shards: int, rows_per_shard: int,
     fault_rate: float, n_agents: int, deadline_sec: float,
-    reference: str,
+    reference: str, pipeline: bool = False,
 ) -> List[str]:
     problems: List[str] = []
+    if pipeline:
+        n_agents = 1  # the pipelined drive owns one device loop
     plan = FaultPlan(
         seed=seed,
         drop_request=fault_rate * 0.5,
@@ -191,7 +239,9 @@ def run_chaos(
         drop_lease=0.10,
         duplicate_task=0.05,
         stale_epoch=0.05,
-        agent_crash=0.05,
+        # Crash-restart is a step-loop construct; the pipelined drive never
+        # consults it, so keep the plan's decision stream comparable.
+        agent_crash=0.0 if pipeline else 0.05,
     )
     # Short TTL so abandoned leases requeue inside the deadline; a generous
     # per-job budget because chaos retries must not exhaust it (transport
@@ -208,7 +258,7 @@ def run_chaos(
     ]
     try:
         agents, crashes, drained = drive_drain(
-            controller, agents, plan, deadline_sec
+            controller, agents, plan, deadline_sec, pipeline=pipeline
         )
     finally:
         controller.close()
@@ -543,6 +593,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--tenants", type=int, default=3,
                     help="tenant count for --policy fair (1 bulk + N-1 "
                          "interactive)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="drive the reference + chaos drains through the "
+                         "real PipelineRunner (staging pool, "
+                         "STAGE_WORKERS/STAGE_AUTOTUNE honored) instead of "
+                         "the serial step loop (ISSUE 6)")
     args = ap.parse_args(argv)
 
     shards = args.shards
@@ -563,7 +618,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         csv_path = os.path.join(tmp, "rows.csv")
         build_csv(csv_path, shards * rows)
         reference, ref_problems = run_reference(csv_path, shards, rows,
-                                                deadline)
+                                                deadline,
+                                                pipeline=args.pipeline)
         problems += ref_problems
         if not ref_problems:
             for seed in seeds:
@@ -576,7 +632,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     problems += run_chaos(
                         seed, csv_path, shards, rows, args.fault_rate,
                         args.agents, deadline, reference,
+                        pipeline=args.pipeline,
                     )
+                    # The outage scenario is deliberately step-driven (it
+                    # gates the session mid-lease); it runs serial either
+                    # way.
                     problems += run_outage(
                         seed, csv_path, shards, rows, deadline
                     )

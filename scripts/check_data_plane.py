@@ -1,0 +1,301 @@
+#!/usr/bin/env python3
+"""Data-plane smoke (ISSUE 6) — the CI gate for the staging pool and the
+binary shard wire. Three checks, all in-process on the CPU backend:
+
+1. **Parallel staging is bit-identical**: a multi-shard classify drain
+   through the real ``PipelineRunner`` with ``STAGE_WORKERS=4`` + autotune
+   + the double-buffered feed produces exactly the results of the
+   single-worker reference drain (per-shard indices AND scores).
+2. **Binary-wire negotiation, both directions**: a binary-capable agent
+   against a JSON-only controller (``wire_binary=False``) and a JSON-only
+   agent (``WIRE_BINARY=0`` semantics) against a binary controller both
+   stay on plain JSON; the negotiated pair demonstrably carries the
+   ``__bin__`` envelope on tasks and results, stores bit-identical decoded
+   results, and shrinks task+result wire bytes/row by ≥ 3× vs JSON.
+3. **Chaos composes**: ``chaos_soak.py --quick --pipeline`` (the soak's
+   drains driven through the staging pool, ``STAGE_WORKERS=4``) is green.
+
+Exit 0 = all clean; 1 = problems (listed one per line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TINY = {
+    "d_model": 32, "n_heads": 4, "n_layers": 1, "d_ff": 64,
+    "max_len": 64, "dtype": "float32", "n_classes": 16,
+}
+TINY_S2S = {
+    "d_model": 32, "n_heads": 4, "n_enc_layers": 1, "n_dec_layers": 1,
+    "d_ff": 64, "max_src_len": 64, "max_tgt_len": 16, "dtype": "float32",
+}
+ROWS, SHARD = 192, 24
+
+
+class CountingSession:
+    """Loopback wrapper that measures what the JSON wire WOULD carry for
+    the shard payloads themselves — ``len(json.dumps(...))`` of every
+    posted ``result`` body and every leased task ``payload`` (the ISSUE 6
+    acceptance bar is task+result bytes/row; lease metrics/span piggyback
+    is control-plane traffic and identical in both modes)."""
+
+    def __init__(self, inner: Any) -> None:
+        self.inner = inner
+        self.bytes_results = 0
+        self.bytes_tasks = 0
+        self.binary_results = 0
+        self.binary_tasks = 0
+
+    def post(self, url: str, json_body: Any = None, timeout: Any = None,
+             **kw: Any):
+        body = kw.pop("json", json_body)
+        resp = self.inner.post(url, json=body, timeout=timeout)
+        from agent_tpu.data import wire
+
+        def nbytes(obj: Any) -> int:
+            return len(json.dumps(obj, separators=(",", ":"), default=str))
+
+        if url.endswith("/v1/results") and isinstance(body, dict):
+            self.bytes_results += nbytes(body.get("result"))
+            if wire.is_binary_result(body.get("result")):
+                self.binary_results += 1
+        elif url.endswith("/v1/leases") and resp.status_code == 200:
+            for task in resp.json().get("tasks", []):
+                self.bytes_tasks += nbytes(task.get("payload"))
+                if wire.is_binary_payload(task.get("payload")):
+                    self.binary_tasks += 1
+        return resp
+
+
+def build_csv(path: str, rows: int) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("id,text\n")
+        for i in range(rows):
+            f.write(f'{i},"data plane smoke row {i} with a text payload"\n')
+
+
+def drain_pipelined(controller, agent, workers, autotune, deadline_sec=120.0):
+    from agent_tpu.agent.pipeline import PipelineRunner
+
+    agent.post_session_factory = lambda: agent.session
+    agent.running = True
+    deadline = time.monotonic() + deadline_sec
+
+    def watch():
+        while not controller.drained() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        agent.running = False
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    PipelineRunner(agent, depth=2, workers=workers, autotune=autotune).run()
+    t.join(timeout=10)
+    return controller.drained()
+
+
+def make_agent(controller, name, tasks, wire_binary=True, session=None):
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.chaos import LoopbackSession
+    from agent_tpu.config import AgentConfig, Config
+
+    cfg = Config(agent=AgentConfig(
+        controller_url="http://loopback", agent_name=name, tasks=tasks,
+        idle_sleep_sec=0.0, max_tasks=2, wire_binary=wire_binary,
+    ))
+    agent = Agent(
+        config=cfg, session=session or LoopbackSession(controller)
+    )
+    agent._profile = {"tier": "smoke"}
+    return agent
+
+
+def check_parallel_staging() -> List[str]:
+    """Multi-worker staged drain must be bit-identical to single-worker."""
+    from agent_tpu.controller.core import Controller
+
+    problems: List[str] = []
+    extra = {"text_field": "text", "allow_fallback": False,
+             "result_format": "columnar", "model_config": dict(TINY),
+             "topk": 3}
+    with tempfile.TemporaryDirectory(prefix="data_plane_") as tmp:
+        csv = os.path.join(tmp, "rows.csv")
+        build_csv(csv, ROWS)
+        by_workers = {}
+        for workers, autotune in ((1, False), (4, True)):
+            controller = Controller()
+            controller.submit_csv_job(
+                csv, total_rows=ROWS, shard_size=SHARD,
+                map_op="map_classify_tpu", extra_payload=extra,
+            )
+            agent = make_agent(controller, f"stage-{workers}",
+                               ("map_classify_tpu",))
+            if not drain_pipelined(controller, agent, workers, autotune):
+                return [f"staging drain (workers={workers}) did not finish: "
+                        f"{controller.counts()}"]
+            counts = controller.counts()
+            if counts != {"succeeded": ROWS // SHARD}:
+                return [f"staging drain (workers={workers}) bad counts "
+                        f"{counts}"]
+            by_workers[workers] = {
+                controller.job(j).payload["start_row"]: r
+                for j, r in controller.results().items()
+            }
+        for start, want in sorted(by_workers[1].items()):
+            got = by_workers[4][start]
+            for key in ("indices", "scores"):
+                if got[key] != want[key]:
+                    problems.append(
+                        f"multi-worker shard @{start} diverged on {key!r}"
+                    )
+    if not problems:
+        print(json.dumps({
+            "check": "parallel_staging", "workers": [1, 4],
+            "shards": ROWS // SHARD, "bit_identical": True, "ok": True,
+        }, sort_keys=True))
+    return problems
+
+
+def _texts_jobs(controller, texts):
+    ids = []
+    for i in range(0, len(texts), 64):
+        ids.append(controller.submit("map_classify_tpu", {
+            "texts": texts[i:i + 64], "topk": 3,
+            "result_format": "columnar", "model_config": dict(TINY),
+            "allow_fallback": False,
+        }))
+    ids.append(controller.submit("map_summarize", {
+        "texts": texts[:32], "max_length": 6,
+        "model_config": dict(TINY_S2S),
+    }))
+    return ids
+
+
+def _drain_serial(controller, agent, max_steps=64):
+    for _ in range(max_steps):
+        if controller.drained():
+            return True
+        agent.step()
+    return controller.drained()
+
+
+def check_wire_negotiation() -> List[str]:
+    """Negotiation matrix + the ≥3× task+result bytes/row bar."""
+    from agent_tpu.chaos import LoopbackSession
+    from agent_tpu.controller.core import Controller
+
+    problems: List[str] = []
+    texts = [f"binary wire check row {i} with some payload text"
+             for i in range(256)]
+    tasks = ("map_classify_tpu", "map_summarize")
+    runs = {}
+    for label, ctrl_bin, agent_bin in (
+        ("json", False, True),     # binary agent vs JSON-only controller
+        ("json_agent", True, False),  # JSON-only agent vs binary controller
+        ("binary", True, True),
+    ):
+        controller = Controller(wire_binary=ctrl_bin)
+        job_ids = _texts_jobs(controller, texts)
+        session = CountingSession(LoopbackSession(controller))
+        agent = make_agent(controller, f"wire-{label}", tasks,
+                           wire_binary=agent_bin, session=session)
+        if not _drain_serial(controller, agent):
+            return [f"wire drain {label!r} did not finish: "
+                    f"{controller.counts()}"]
+        runs[label] = {
+            "results": [controller.job_snapshot(j)["result"]
+                        for j in job_ids],
+            "session": session,
+        }
+
+    for label in ("json", "json_agent"):
+        s = runs[label]["session"]
+        if s.binary_tasks or s.binary_results:
+            problems.append(
+                f"{label}: envelope on the wire without negotiation "
+                f"(tasks={s.binary_tasks}, results={s.binary_results})"
+            )
+    s_bin = runs["binary"]["session"]
+    if not s_bin.binary_tasks or not s_bin.binary_results:
+        problems.append(
+            f"binary: negotiation did not engage (tasks="
+            f"{s_bin.binary_tasks}, results={s_bin.binary_results})"
+        )
+    for ref_res, bin_res in zip(runs["json"]["results"],
+                                runs["binary"]["results"]):
+        for key in ("indices", "scores", "summaries", "summary"):
+            if (ref_res or {}).get(key) != (bin_res or {}).get(key):
+                problems.append(f"binary vs JSON result diverged on {key!r}")
+
+    rows = len(texts) + 32  # classify rows + summarize rows
+    s_json = runs["json"]["session"]
+    bytes_json = s_json.bytes_results + s_json.bytes_tasks
+    bytes_bin = s_bin.bytes_results + s_bin.bytes_tasks
+    shrink = bytes_json / max(1, bytes_bin)
+    if shrink < 3.0:
+        problems.append(
+            f"binary wire shrank task+result bytes only {shrink:.2f}x "
+            f"(json {bytes_json} B vs binary {bytes_bin} B) — bar is 3x"
+        )
+    if not problems:
+        print(json.dumps({
+            "check": "wire_negotiation",
+            "bytes_per_row_json": round(bytes_json / rows, 1),
+            "bytes_per_row_binary": round(bytes_bin / rows, 1),
+            "wire_shrink_x": round(shrink, 2),
+            "binary_tasks": s_bin.binary_tasks,
+            "binary_results": s_bin.binary_results,
+            "ok": True,
+        }, sort_keys=True))
+    return problems
+
+
+def check_chaos_with_pool() -> List[str]:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["STAGE_WORKERS"] = "4"
+    env["STAGE_AUTOTUNE"] = "1"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "chaos_soak.py"),
+         "--seed", "7", "--shards", "16", "--quick", "--pipeline"],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    if proc.returncode != 0:
+        return [
+            "chaos_soak --quick --pipeline (STAGE_WORKERS=4) failed:",
+            proc.stdout[-2000:], proc.stderr[-2000:],
+        ]
+    print(json.dumps({"check": "chaos_with_pool", "ok": True}))
+    return []
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    problems: List[str] = []
+    problems += check_parallel_staging()
+    problems += check_wire_negotiation()
+    problems += check_chaos_with_pool()
+    elapsed = round(time.monotonic() - t0, 1)
+    if problems:
+        for p in problems:
+            print(p)
+        print(f"check_data_plane: FAILED ({len(problems)} problem(s), "
+              f"{elapsed}s)")
+        return 1
+    print(f"check_data_plane: OK ({elapsed}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

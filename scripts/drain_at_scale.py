@@ -278,22 +278,43 @@ def main() -> int:
             busy_ms = op_span_ms(ok_results, drain_ops)
             busy_s = {op: busy_ms[op] / 1e3 for op in drain_ops}
 
-        # Slowest-job trace (ISSUE 5 satellite): one line of per-phase
-        # attribution from GET /v1/trace/{job_id}. A broken trace path
-        # fails the drain loudly rather than rotting silently.
+        # Slowest-job trace (ISSUE 5 satellite) + stage/execute overlap
+        # (ISSUE 6 satellite): per-phase attribution and the cross-job
+        # concurrency ratio, both from GET /v1/trace/*. A broken trace path
+        # FAILS the drain (nonzero exit) rather than silently omitting the
+        # breakdown.
         from agent_tpu.obs import trace as obs_trace
-        from agent_tpu.obs.scrape import slowest_trace
+        from agent_tpu.obs.scrape import slowest_trace, stage_execute_overlap
         from agent_tpu.obs.trace import phase_breakdown
 
         trace_line = None
+        overlap = None
         if obs_trace.enabled():
             worst = slowest_trace(server.url)
-            assert worst is not None, (
-                "trace path broken: /v1/traces or /v1/trace/{job_id} "
-                "returned nothing for a drained run"
-            )
+            if worst is None:
+                print(
+                    "DRAIN FAILED: trace path broken — /v1/traces or "
+                    "/v1/trace/{job_id} returned nothing for a drained run",
+                    flush=True,
+                )
+                return 1
             trace_line = phase_breakdown(worst)
             print(f"[slowest shard] {trace_line}", flush=True)
+            overlap = stage_execute_overlap(server.url)
+            if overlap is None:
+                print(
+                    "DRAIN FAILED: no closed stage/execute spans in the "
+                    "trace window — overlap breakdown unavailable",
+                    flush=True,
+                )
+                return 1
+            print(
+                f"[overlap] {overlap['overlap_ratio']:.3f} of stage wall "
+                f"time hidden behind execute (stage p50 "
+                f"{overlap['stage_p50_ms']:.1f} ms vs execute p50 "
+                f"{overlap['execute_p50_ms']:.1f} ms)",
+                flush=True,
+            )
 
     report = {
         "rows": args.rows,
@@ -320,6 +341,10 @@ def main() -> int:
         # Per-phase breakdown of the slowest job's assembled trace
         # (GET /v1/trace/{job_id}); None only with TRACE_ENABLED=0.
         "slowest_trace": trace_line,
+        # Stage/execute concurrency over the trace window (ISSUE 6): the
+        # fraction of stage wall time the staging pool hid behind device
+        # execute, with per-phase p50s; None only with TRACE_ENABLED=0.
+        "stage_execute_overlap": overlap,
         "classify": {
             "shard_size": CLASSIFY_SHARD,
             "rows_written": rows_written["map_classify_tpu"],

@@ -81,6 +81,7 @@ def test_lease_capabilities_carry_device_and_load_fields():
         "queue_depth": 3,
         "device_kind": "tpu",
         "mesh_devices": 8,
+        "wire_formats": ["b1"],
     }
 
 
@@ -92,8 +93,83 @@ def test_lease_capabilities_without_runtime_omit_device_fields():
     agent._profile = {}
     assert agent.lease_once() is None
     _, body = session.requests[0]
-    assert body["capabilities"] == {"ops": ["echo"], "queue_depth": 0}
+    assert body["capabilities"] == {
+        "ops": ["echo"], "queue_depth": 0, "wire_formats": ["b1"],
+    }
     assert agent.runtime is None
+
+
+def test_wire_binary_off_drops_the_capability_advert():
+    """WIRE_BINARY=0 agents must look exactly like pre-wire agents on the
+    lease body (the negotiation is strictly opt-in from both sides)."""
+    session = StubSession([StubResponse(204)])
+    agent = Agent(config=fast_config(wire_binary=False), session=session)
+    agent._profile = {}
+    assert agent.lease_once() is None
+    _, body = session.requests[0]
+    assert body["capabilities"] == {"ops": ["echo"], "queue_depth": 0}
+
+
+def test_metrics_flush_ships_fresh_queue_depth():
+    """ISSUE 6 satellite: every channel that ships capabilities samples
+    ``staged_q.qsize()`` at request-BUILD time — including the poster's
+    metrics-only flush, which used to advertise no depth at all and could
+    lag reality by a whole poll cycle."""
+    session = StubSession([StubResponse(204), StubResponse(204)])
+    agent = Agent(config=fast_config(), session=session)
+    agent._profile = {}
+    depth = {"n": 5}
+    agent.staged_depth_fn = lambda: depth["n"]
+    assert agent.push_metrics() is True
+    depth["n"] = 2  # queue drained between the two flushes
+    assert agent.push_metrics() is True
+    first, second = (body for _, body in session.requests)
+    assert first["max_tasks"] == 0 and second["max_tasks"] == 0
+    assert first["capabilities"] == {"ops": [], "queue_depth": 5}
+    assert second["capabilities"] == {"ops": [], "queue_depth": 2}
+
+
+def test_lease_batch_hint_raises_the_grant_ask():
+    """The staging pool's hint lifts max_tasks on the wire (never below the
+    configured MAX_TASKS); without a hint the legacy ask is unchanged."""
+    session = StubSession([StubResponse(204), StubResponse(204)])
+    agent = Agent(config=fast_config(max_tasks=2), session=session)
+    agent._profile = {}
+    assert agent.lease_once() is None
+    agent.lease_batch_hint = 4
+    assert agent.lease_once() is None
+    (_, first), (_, second) = session.requests
+    assert first["max_tasks"] == 2
+    assert second["max_tasks"] == 4
+
+
+def test_binary_task_payload_decodes_before_dispatch():
+    """A controller-encoded ``__bin__`` payload reaches the op as the plain
+    decoded dict; a corrupt envelope fails the task like any malformed
+    task (structured error, no crash)."""
+    from agent_tpu.data import wire
+
+    good = wire.encode_task_payload({"texts": ["a", "b"], "topk": 1})
+    lease = StubResponse(200, {
+        "lease_id": "L1",
+        "wire": "b1",
+        "tasks": [
+            {"id": "j1", "op": "echo", "payload": good, "job_epoch": 0},
+            {"id": "j2", "op": "echo",
+             "payload": {"__bin__": "!!not base64!!"}, "job_epoch": 0},
+        ],
+    })
+    session = StubSession([lease, StubResponse(200, {}),
+                           StubResponse(200, {})])
+    agent = Agent(config=fast_config(max_tasks=2), session=session)
+    agent._profile = {}
+    agent.step()
+    assert agent.wire_format == "b1"
+    _, ok_body = session.requests[1]
+    assert ok_body["result"]["echo"] == {"texts": ["a", "b"], "topk": 1}
+    _, bad_body = session.requests[2]
+    assert bad_body["status"] == "failed"
+    assert bad_body["error"]["type"] == "ValueError"
 
 
 def test_transport_error_raises_for_backoff():

@@ -1,0 +1,360 @@
+"""Binary shard wire (ISSUE 6): codec round trips (seeded-random always-on
+plus a hypothesis variant, matching the ``tests/test_sched.py`` pattern),
+the JSON-equivalence contract for classify/summarize columns, the
+compressed/uncompressed fallback, negotiation in both directions, and a
+full JSON↔binary LoopbackSession drain equivalence."""
+
+import json
+import random
+import string
+
+import numpy as np
+import pytest
+
+from agent_tpu.data import wire
+
+# ---------------------------------------------------------------------------
+# Codec round trips
+# ---------------------------------------------------------------------------
+
+
+def _random_cols(rng: random.Random):
+    """One random column set: arrays (every supported dtype), string lists
+    (non-ASCII, empty strings, empty lists), and JSON leftovers."""
+    cols = {}
+    n = rng.randint(1, 5)
+    alphabet = string.ascii_letters + "äöüß日本語🙂 ,\"'\\\n"
+    for i in range(n):
+        kind = rng.choice(("arr_i", "arr_f", "strs", "json"))
+        name = f"c{i}"
+        if kind == "arr_i":
+            dtype = rng.choice(
+                (np.int8, np.int16, np.int32, np.int64, np.uint8, np.uint16)
+            )
+            info = np.iinfo(dtype)
+            shape = rng.choice(((rng.randint(0, 8),),
+                                (rng.randint(1, 6), rng.randint(1, 4))))
+            cols[name] = np.array(
+                [rng.randint(max(info.min, -1000), min(info.max, 1000))
+                 for _ in range(int(np.prod(shape)))],
+                dtype=dtype,
+            ).reshape(shape)
+        elif kind == "arr_f":
+            dtype = rng.choice((np.float32, np.float64))
+            shape = (rng.randint(0, 16),)
+            cols[name] = np.array(
+                [rng.uniform(-1e6, 1e6) for _ in range(shape[0])], dtype=dtype
+            )
+        elif kind == "strs":
+            cols[name] = [
+                "".join(rng.choice(alphabet)
+                        for _ in range(rng.randint(0, 40)))
+                for _ in range(rng.randint(0, 12))
+            ]
+        else:
+            cols[name] = {
+                "k": rng.randint(-5, 5),
+                "v": [rng.random(), None, "πλ"],
+            }
+    return cols
+
+
+def _expect(cols):
+    """What decode must return: arrays tolist()-ed, everything else as-is
+    (JSON values round-trip through json semantics)."""
+    out = {}
+    for k, v in cols.items():
+        if isinstance(v, np.ndarray):
+            out[k] = v.tolist()
+        else:
+            out[k] = json.loads(json.dumps(v)) if not (
+                isinstance(v, list) and all(isinstance(t, str) for t in v)
+            ) else v
+    return out
+
+
+def test_round_trip_seeded_random():
+    for seed in range(40):
+        rng = random.Random(seed)
+        cols = _random_cols(rng)
+        compress = rng.choice((None, True, False))
+        got = wire.decode_blob(wire.encode_blob(cols, compress=compress))
+        assert got == _expect(cols), f"seed {seed} (compress={compress})"
+
+
+def test_round_trip_hypothesis():
+    hyp = pytest.importorskip("hypothesis")
+    st = pytest.importorskip("hypothesis.strategies")
+
+    @hyp.settings(deadline=None, max_examples=50)
+    @hyp.given(seed=st.integers(min_value=0, max_value=2**31),
+               compress=st.sampled_from((None, True, False)))
+    def run(seed, compress):
+        cols = _random_cols(random.Random(seed))
+        got = wire.decode_blob(wire.encode_blob(cols, compress=compress))
+        assert got == _expect(cols)
+
+    run()
+
+
+def test_compression_flag_and_fallback():
+    """Adaptive compression keeps zlib only when it shrinks; the flag byte
+    records which body the blob carries and both decode identically."""
+    repetitive = {"texts": ["the same line"] * 200}
+    blob = wire.encode_blob(repetitive)
+    assert blob[2] & 0x01, "repetitive text should have compressed"
+    assert len(blob) < len(json.dumps(repetitive["texts"]))
+
+    raw = wire.encode_blob(repetitive, compress=False)
+    assert not raw[2] & 0x01
+    assert wire.decode_blob(raw) == wire.decode_blob(blob)
+
+    # High-entropy bytes (uint8 of a seeded RNG) must not be bloated by a
+    # futile zlib pass: adaptive falls back to the uncompressed body.
+    noise = {"v": np.frombuffer(random.Random(3).randbytes(4096), np.uint8)}
+    adaptive = wire.encode_blob(noise)
+    forced = wire.encode_blob(noise, compress=True)
+    assert not adaptive[2] & 0x01
+    assert len(adaptive) <= len(forced) + 16
+    assert wire.decode_blob(adaptive) == wire.decode_blob(forced)
+
+
+def test_malformed_blobs_raise_value_error():
+    good = wire.encode_blob({"a": [1, 2]})
+    for bad in (b"", b"XX\x00", good[:-3], b"AW\x01notzlib",
+                good[:2] + b"\x01" + b"\x00" * 4):
+        with pytest.raises(ValueError):
+            wire.decode_blob(bad)
+    with pytest.raises(ValueError):
+        wire.unpack_b64("!!! not base64 !!!")
+    with pytest.raises(ValueError):
+        wire.unpack_b64(12345)  # type: ignore[arg-type]
+
+
+def test_int_width_shrink_preserves_values():
+    arr = np.array([[0, 1], [126, -127]], dtype=np.int32)
+    blob = wire.encode_blob({"i": arr}, compress=False)
+    # int8 on the wire (1 byte/value) but the SAME Python ints back.
+    assert wire.decode_blob(blob)["i"] == arr.tolist()
+    small = len(blob)
+    wide = len(wire.encode_blob(
+        {"i": np.array([[0, 1], [126, 1 << 20]], np.int32)}, compress=False))
+    assert small < wide
+
+
+# ---------------------------------------------------------------------------
+# JSON-equivalence of the op column shapes
+# ---------------------------------------------------------------------------
+
+
+def test_classify_columns_match_json_path_bitwise():
+    """The binary classify result decodes to EXACTLY the lists the JSON
+    finalize would have produced: same np.round(f32, 6) → widen floats,
+    same ints."""
+    rng = np.random.default_rng(11)
+    vals = rng.random((64, 5), dtype=np.float32)
+    idx = rng.integers(0, 1000, (64, 5)).astype(np.int32)
+    json_shape = {
+        "indices": np.asarray(idx).tolist(),
+        "scores": np.round(np.asarray(vals), 6).tolist(),
+    }
+    result = wire.attach_result_columns(
+        {"ok": True, "op": "map_classify_tpu"},
+        {"indices": np.ascontiguousarray(idx),
+         "scores": np.round(np.asarray(vals), 6)},
+    )
+    decoded = wire.decode_result(result)
+    assert decoded["indices"] == json_shape["indices"]
+    assert decoded["scores"] == json_shape["scores"]
+    assert "__bin__" not in decoded
+
+
+def test_summarize_columns_round_trip_with_empty_and_non_ascii():
+    summaries = ["ein Résumé 🙂", "", "plain", "改行\nあり"]
+    result = wire.attach_result_columns(
+        {"ok": True, "op": "map_summarize", "summary": summaries[0]},
+        {"summaries": summaries},
+    )
+    decoded = wire.decode_result(result)
+    assert decoded["summaries"] == summaries
+    assert decoded["summary"] == summaries[0]
+
+
+def test_task_payload_round_trip_and_empty_shard():
+    payload = {
+        "texts": ["ä", "", "long row " * 50],
+        "topk": 3, "result_format": "columnar",
+        "model_config": {"d_model": 32}, "allow_fallback": False,
+    }
+    enc = wire.encode_task_payload(payload)
+    assert set(enc) == {"__bin__"}
+    assert wire.decode_task_payload(enc) == payload
+    # Empty texts (an empty shard) round-trips too — encodable_task refuses
+    # to encode it (nothing to gain), but the codec itself must not choke.
+    empty = {"texts": [], "topk": 1}
+    assert wire.decode_task_payload(wire.encode_task_payload(empty)) == empty
+    assert not wire.encodable_task("map_classify_tpu", empty)
+    assert not wire.encodable_task("echo", payload)
+    assert wire.encodable_task("map_classify_tpu", payload)
+    assert wire.encodable_task("map_summarize", payload)
+
+
+# ---------------------------------------------------------------------------
+# Negotiation + full LoopbackSession drain equivalence
+# ---------------------------------------------------------------------------
+
+TINY = {
+    "d_model": 32, "n_heads": 4, "n_layers": 1, "d_ff": 64,
+    "max_len": 64, "dtype": "float32", "n_classes": 16,
+}
+
+TINY_S2S = {
+    "d_model": 32, "n_heads": 4, "n_enc_layers": 1, "n_dec_layers": 1,
+    "d_ff": 64, "max_src_len": 64, "max_tgt_len": 16, "dtype": "float32",
+}
+
+
+def _drain_loopback(wire_binary_controller=True, wire_binary_agent=True,
+                    observe=None):
+    """Submit one classify (texts payload, columnar) + one summarize job,
+    drain through the real serial agent loop over a LoopbackSession, and
+    return (controller, classify_result, summarize_result)."""
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.chaos import LoopbackSession
+    from agent_tpu.config import AgentConfig, Config
+    from agent_tpu.controller.core import Controller
+
+    controller = Controller(wire_binary=wire_binary_controller)
+    texts = [f"wire équivalence row {i} 🙂" for i in range(24)]
+    c_id = controller.submit("map_classify_tpu", {
+        "texts": texts, "topk": 3, "result_format": "columnar",
+        "model_config": dict(TINY), "allow_fallback": False,
+    })
+    s_id = controller.submit("map_summarize", {
+        "texts": texts[:8], "max_length": 6,
+        "model_config": dict(TINY_S2S),
+    })
+    session = LoopbackSession(controller)
+    if observe is not None:
+        session = observe(session)
+    cfg = Config(agent=AgentConfig(
+        controller_url="http://loopback", agent_name="wire-test",
+        tasks=("map_classify_tpu", "map_summarize"),
+        idle_sleep_sec=0.0, wire_binary=wire_binary_agent, max_tasks=2,
+    ))
+    agent = Agent(config=cfg, session=session)
+    agent._profile = {"tier": "test"}
+    for _ in range(16):
+        if controller.drained():
+            break
+        agent.step()
+    assert controller.drained(), controller.counts()
+    return (
+        controller,
+        controller.job_snapshot(c_id)["result"],
+        controller.job_snapshot(s_id)["result"],
+    )
+
+
+class _Recorder:
+    """Session wrapper that records every posted body and returned lease."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.posted = []
+        self.leases = []
+
+    def post(self, url, json=None, timeout=None):  # noqa: A002
+        self.posted.append((url, json))
+        resp = self.inner.post(url, json=json, timeout=timeout)
+        if url.endswith("/v1/leases") and resp.status_code == 200:
+            self.leases.append(resp.json())
+        return resp
+
+
+def test_loopback_drain_json_binary_equivalence():
+    """The acceptance bar: a binary-wire drain stores bit-identical results
+    to a JSON-wire drain, while the wire itself demonstrably carried the
+    envelope (tasks AND results) only in the negotiated case."""
+    _, c_json, s_json = _drain_loopback(wire_binary_controller=False)
+    rec = {}
+
+    def observing(inner):
+        rec["session"] = _Recorder(inner)
+        return rec["session"]
+
+    controller, c_bin, s_bin = _drain_loopback(
+        wire_binary_controller=True, observe=observing
+    )
+    assert c_bin["indices"] == c_json["indices"]
+    assert c_bin["scores"] == c_json["scores"]
+    assert s_bin["summaries"] == s_json["summaries"]
+    assert s_bin["summary"] == s_json["summary"]
+    # The stored results never expose the envelope…
+    assert "__bin__" not in c_bin and "__bin__" not in s_bin
+    # …but the wire actually carried it: negotiated grants, encoded task
+    # payloads, and binary result bodies.
+    session = rec["session"]
+    assert any(body.get("wire") == "b1" for body in session.leases)
+    wired_tasks = [
+        t for body in session.leases for t in body.get("tasks", [])
+        if wire.is_binary_payload(t.get("payload"))
+    ]
+    assert wired_tasks, "no task payload was binary-encoded"
+    wired_results = [
+        b for url, b in session.posted
+        if url.endswith("/v1/results") and wire.is_binary_result(b.get("result"))
+    ]
+    assert wired_results, "no result body was binary-encoded"
+    snap = controller.metrics.snapshot()
+    series = {
+        s["labels"]["direction"]: s["value"]
+        for s in snap.get("controller_wire_total", {}).get("series", [])
+    }
+    assert series.get("task", 0) >= 1
+    assert series.get("result", 0) >= 2
+
+
+def test_json_only_agent_against_binary_controller():
+    """Opt-in is bilateral: a WIRE_BINARY=0 agent never advertises, so a
+    binary-capable controller keeps the whole exchange plain JSON."""
+    rec = {}
+
+    def observing(inner):
+        rec["session"] = _Recorder(inner)
+        return rec["session"]
+
+    _, c_res, s_res = _drain_loopback(
+        wire_binary_controller=True, wire_binary_agent=False,
+        observe=observing,
+    )
+    session = rec["session"]
+    assert all("wire" not in body for body in session.leases)
+    assert all(
+        not wire.is_binary_payload(t.get("payload"))
+        for body in session.leases for t in body.get("tasks", [])
+    )
+    assert all(
+        not wire.is_binary_result(b.get("result"))
+        for url, b in session.posted if url.endswith("/v1/results")
+    )
+    assert isinstance(c_res["indices"], list)
+    assert isinstance(s_res["summaries"], list)
+
+
+def test_undecodable_result_envelope_is_counted_not_fatal():
+    from agent_tpu.controller.core import Controller
+
+    c = Controller()
+    c.submit("echo", {}, job_id="j1")
+    lease = c.lease("a", {"ops": ["echo"]})
+    c.report(lease["lease_id"], "j1", 0, "succeeded",
+             result={"ok": True, "__bin__": "@@@ corrupt @@@"})
+    job = c.job_snapshot("j1")
+    assert job["state"] == "succeeded"
+    assert job["result"]["__bin__"] == "@@@ corrupt @@@"  # kept, debuggable
+    series = {
+        s["labels"]["direction"]: s["value"]
+        for s in c.metrics.snapshot()["controller_wire_total"]["series"]
+    }
+    assert series.get("result_error") == 1
