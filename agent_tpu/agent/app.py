@@ -274,6 +274,12 @@ class Agent:
             # answers with ``wire: "b1"``; a legacy one ignores the key and
             # the whole exchange stays plain JSON.
             caps["wire_formats"] = list(wire.FORMATS)
+        if self.config.device.chip_slice:
+            # Device-pinned fleet member (ISSUE 7): which slice of the
+            # host's chips this agent owns. Informational for operators and
+            # the fleet view; placement keeps reading device_kind/
+            # mesh_devices/queue_depth.
+            caps["chip_slice"] = self.config.device.chip_slice
         if self.runtime is not None:
             try:
                 desc = self.runtime.describe()

@@ -197,6 +197,14 @@ class DeviceConfig:
     # Fused Pallas attention kernel on TPU (PALLAS_ATTN=0 falls back to the
     # XLA dot-product path; CPU/GPU always use the XLA path).
     pallas_attn: bool = True
+    # Device-pinned fleets (ISSUE 7): "start:count" slice of this host's
+    # visible devices the runtime may own ("" = all of them). The fleet
+    # launcher (agent/fleet.py) gives each agent process a disjoint slice so
+    # N single-slice agents share one host without fighting over chips; on
+    # TPU hardware the launcher additionally pins visibility at the process
+    # level (TPU_VISIBLE_DEVICES), making the in-process slice an identity
+    # check rather than the only fence.
+    chip_slice: str = ""                        # CHIP_SLICE "start:count"
     # Multi-host SPMD (jax.distributed.initialize trio); unset → single host.
     coordinator_address: Optional[str] = None   # COORDINATOR_ADDRESS host:port
     num_processes: Optional[int] = None         # NUM_PROCESSES
@@ -232,6 +240,7 @@ class DeviceConfig:
             quant=env_str("TPU_QUANT", "").strip().lower(),
             compile_cache_dir=env_str("JAX_COMPILATION_CACHE_DIR", ""),
             pallas_attn=env_bool("PALLAS_ATTN", True),
+            chip_slice=env_str("CHIP_SLICE", "").strip(),
             coordinator_address=os.environ.get("COORDINATOR_ADDRESS") or None,
             num_processes=(
                 env_int("NUM_PROCESSES", 0) or None

@@ -1483,11 +1483,42 @@ class Controller:
             ]
         return merge_snapshots(snaps)
 
+    # Counter families that join the gauges in the per-agent view: load/
+    # utilization series whose per-agent split is the whole point of a
+    # fleet drain's attribution (ISSUE 7 satellite).
+    _PER_AGENT_COUNTERS = (
+        "device_busy_seconds_total",
+        "device_idle_seconds_total",
+    )
+
+    def _per_agent_view(self, snap: Dict[str, Any]) -> Dict[str, Any]:
+        """The families of one agent's snapshot that also render PER AGENT
+        (stamped with an ``agent`` label): every gauge — summing two agents'
+        ``queue_depth`` into one fleet series collapses exactly the signal a
+        fleet operator needs — plus the device busy/idle counters."""
+        return {
+            name: fam for name, fam in snap.items()
+            if isinstance(fam, dict) and (
+                fam.get("type") == "gauge"
+                or name in self._PER_AGENT_COUNTERS
+            )
+        }
+
     def metrics_text(self) -> str:
         """The full Prometheus exposition: controller series, fleet-merged
         agent series, and a synthetic per-agent liveness gauge. Agent metric
         names never collide with the ``controller_``-prefixed families, so
-        one flat exposition stays valid."""
+        one flat exposition stays valid.
+
+        Fleet hygiene (ISSUE 7 satellite): when ≥ 2 agents have pushed
+        snapshots, gauge families and the device busy/idle counters
+        ADDITIONALLY render once per agent with an ``agent`` label next to
+        the unlabeled fleet merge — without it the merged view collapses
+        per-agent load into one number and a starving fleet member is
+        invisible. Single-agent expositions keep the legacy (unlabeled)
+        shape byte-for-byte; scrape consumers that sum fleet series must
+        skip ``agent``-labeled samples (``obs.scrape.op_phase_seconds``
+        already does)."""
         liveness = {
             "agent_last_seen_seconds": {
                 "type": "gauge",
@@ -1499,11 +1530,20 @@ class Controller:
                 ],
             }
         }
-        return render_snapshots([
+        with self._lock:
+            agent_snaps = [
+                (a, e.get("obs")) for a, e in self.agent_metrics.items()
+                if isinstance(e.get("obs"), dict)
+            ]
+        parts = [
             (self.metrics.snapshot(), {}),
-            (self.fleet_snapshot(), {}),
-            (liveness, {}),
-        ])
+            (merge_snapshots([s for _, s in agent_snaps]), {}),
+        ]
+        if len(agent_snaps) >= 2:
+            for a, snap in agent_snaps:
+                parts.append((self._per_agent_view(snap), {"agent": a}))
+        parts.append((liveness, {}))
+        return render_snapshots(parts)
 
     def trace_json(self, job_id: str) -> Optional[Dict[str, Any]]:
         """Assembled span tree for one job (``GET /v1/trace/{job_id}``):

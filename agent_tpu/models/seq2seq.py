@@ -167,6 +167,35 @@ def greedy_generate(
     )
 
 
+def greedy_generate_from_encoded(
+    params: Params,
+    enc_out: jax.Array,    # [B, Ls, d] encoder output (cfg.compute_dtype)
+    src_mask: jax.Array,   # [B, Ls] int32
+    cfg: Seq2SeqConfig,
+    max_new_tokens: int,
+    min_length: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Greedy decode from a PRE-COMPUTED encoder output — the decoder half
+    of the MPMD pipeline split (ISSUE 7 stretch, arXiv 2412.14374): an
+    encode-stage agent ships ``enc_out`` through the controller and a
+    decode-stage agent resumes here. Same scan/caches/EOS semantics as
+    :func:`greedy_generate`, which is exactly ``encode(...)`` composed with
+    this function."""
+    from agent_tpu.models.decoding import greedy_scan
+
+    B = enc_out.shape[0]
+    enc_out = enc_out.astype(cfg.compute_dtype)
+
+    def step_fn(tok, step, caches):
+        return _decode_step(params, tok, step, enc_out, src_mask, caches, cfg)
+
+    return greedy_scan(
+        step_fn, _empty_cache(cfg, B), B, max_new_tokens,
+        start_id=BOS_ID, eos_id=EOS_ID, pad_id=PAD_ID,
+        min_length=min_length,
+    )
+
+
 def beam_generate(
     params: Params,
     src_ids: jax.Array,    # [B, Ls] int32

@@ -173,22 +173,68 @@ def overlap_from_spans(spans) -> Optional[Dict[str, Any]]:
     }
 
 
-def stage_execute_overlap(
+def collect_trace_spans(
     base_url: str, limit: int = 64, timeout: float = 10.0
-) -> Optional[Dict[str, Any]]:
-    """:func:`overlap_from_spans` over the controller's newest ``limit``
-    traces (``/v1/traces`` + per-job ``/v1/trace/{id}``). None when the
-    trace path is down or no stage/execute spans assembled — callers that
-    promised the breakdown (drain_at_scale) must fail loudly on None."""
+) -> Optional[list]:
+    """Every span of the controller's newest ``limit`` traces
+    (``/v1/traces`` + per-job ``/v1/trace/{id}``), or None when the trace
+    path is down."""
     listing = fetch_json(base_url, f"/v1/traces?limit={int(limit)}",
                          timeout=timeout)
     if not isinstance(listing, dict):
         return None
-    spans = []
+    spans: list = []
     for entry in listing.get("traces", []):
         if not isinstance(entry, dict) or not entry.get("trace_id"):
             continue
         assembled = fetch_trace(base_url, entry["trace_id"], timeout=timeout)
         if assembled:
             spans.extend(assembled.get("spans", []))
+    return spans
+
+
+def stage_execute_overlap(
+    base_url: str, limit: int = 64, timeout: float = 10.0
+) -> Optional[Dict[str, Any]]:
+    """:func:`overlap_from_spans` over the controller's newest ``limit``
+    traces. None when the trace path is down or no stage/execute spans
+    assembled — callers that promised the breakdown (drain_at_scale) must
+    fail loudly on None."""
+    spans = collect_trace_spans(base_url, limit=limit, timeout=timeout)
+    if spans is None:
+        return None
     return overlap_from_spans(spans)
+
+
+def overlap_by_process(spans) -> Dict[str, Dict[str, Any]]:
+    """Per-AGENT stage/execute overlap (ISSUE 7): spans grouped by their
+    emitting process (``"agent:<name>"``), each group fed through
+    :func:`overlap_from_spans` — the fleet-drain attribution that tells a
+    well-overlapped member from one whose staging starves its device.
+    Controller spans (``process == "controller"``) carry no stage/execute
+    phases and are skipped. ``{agent_name: overlap_dict}``; agents with no
+    closed stage+execute pair are absent."""
+    groups: Dict[str, list] = {}
+    for span in spans or []:
+        if not isinstance(span, dict):
+            continue
+        proc = span.get("process")
+        if isinstance(proc, str) and proc.startswith("agent:"):
+            groups.setdefault(proc[len("agent:"):], []).append(span)
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, group in groups.items():
+        overlap = overlap_from_spans(group)
+        if overlap is not None:
+            out[name] = overlap
+    return out
+
+
+def stage_execute_overlap_by_agent(
+    base_url: str, limit: int = 64, timeout: float = 10.0
+) -> Optional[Dict[str, Dict[str, Any]]]:
+    """:func:`overlap_by_process` over the controller's newest ``limit``
+    traces; None when the trace path is down."""
+    spans = collect_trace_spans(base_url, limit=limit, timeout=timeout)
+    if spans is None:
+        return None
+    return overlap_by_process(spans)

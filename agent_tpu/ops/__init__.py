@@ -52,6 +52,10 @@ OP_TO_MODULE: Dict[str, str] = {
     "map_tokenize": "map_tokenize",
     "map_classify_tpu": "map_classify_tpu",
     "map_summarize": "map_summarize",
+    # MPMD pipeline stages (ISSUE 7 stretch): summarize's encoder and
+    # decoder as separate ops, chained across agents via dep-gating.
+    "summarize_encode": "summarize_mpmd",
+    "summarize_decode": "summarize_mpmd",
     "read_csv_shard": "csv_shard",       # name == registered name (gap 3 fixed)
     "risk_accumulate": "risk_accumulate",
     "trigger_sap": "trigger_sap",        # now a real registered op (gap 4 fixed)

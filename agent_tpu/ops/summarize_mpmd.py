@@ -1,0 +1,294 @@
+"""MPMD pipeline split of summarize: encoder and decoder as SEPARATE ops.
+
+The stretch leg of ISSUE 7, after the MPMD pipeline-parallelism paper
+(arXiv 2412.14374): pipeline *stages* live on *different agents*, with the
+controller's existing dependency gating as the inter-stage queue — no new
+transport. An encode-stage agent (``TASKS=summarize_encode``) leases text
+shards and posts encoder activations; a decode-stage agent
+(``TASKS=summarize_decode``) leases the dep-gated decode job whose
+``partials`` the controller materialized from the encode results, and posts
+the summaries. Capability matching routes each stage to the right fleet;
+``scripts/check_multichip_drain.py`` pins the chain's output equal to the
+monolithic ``map_summarize`` drain.
+
+Wire shape between the stages (a result body, so it rides the ordinary
+``/v1/results`` → ``partials`` path):
+
+    {ok, op: "summarize_encode", model, n_rows, empty_rows,
+     chunks: [{enc: [B][Ls][d] f32, lengths: [B], n: int}, ...]}
+
+Activations ship as plain JSON floats: a float32 → JSON → float32 round
+trip is exact (every f32 is representable as a double), so the decode stage
+resumes from bit-identical encoder state. These are scenario ops for the
+in-house ``seq2seq`` family (checkpoint families keep the fused
+``map_summarize`` path).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from agent_tpu.ops import register_op
+from agent_tpu.utils.errors import bad_input
+
+DEFAULT_MAX_LENGTH = 130
+
+
+def _resolve(payload: Dict[str, Any]):
+    from agent_tpu.models.seq2seq import Seq2SeqConfig
+    from agent_tpu.ops._model_common import (
+        config_from_payload,
+        resolve_model_id,
+    )
+
+    model_id = resolve_model_id(payload, "BART_MODEL", "summarize-default")
+    cfg = config_from_payload(payload, Seq2SeqConfig)
+    return model_id, cfg
+
+
+def _params_key(model_id: str, cfg) -> str:
+    """EXACTLY ``map_summarize``'s params-store key for the seq2seq family,
+    so colocated stages (and the monolithic op) share one HBM copy."""
+    from agent_tpu.ops._model_common import cfg_key
+
+    return f"{model_id}#seq2seq#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}"
+
+
+def _get_params(runtime, model_id: str, cfg):
+    from agent_tpu.models import seq2seq
+    from agent_tpu.ops._model_common import maybe_quantize_specs
+    from agent_tpu.parallel.shardings import seq2seq_param_specs
+
+    specs = maybe_quantize_specs(seq2seq_param_specs(cfg), "seq2seq", cfg)
+    from agent_tpu.ops.map_summarize import _build_params
+
+    return runtime.get_params(
+        _params_key(model_id, cfg),
+        lambda: _build_params(model_id, cfg, "seq2seq"),
+        specs=specs,
+    )
+
+
+def _runtime(ctx):
+    if ctx is not None and getattr(ctx, "require_runtime", None):
+        return ctx.require_runtime()
+    from agent_tpu.runtime.runtime import get_runtime
+
+    return get_runtime()
+
+
+def _put(runtime, arr: np.ndarray):
+    """dp-sharded placement when the batch divides the mesh, else let jit
+    place it — decode batches staged by a DIFFERENT agent's mesh need not
+    divide this one's dp axis."""
+    if arr.shape[0] % max(1, runtime.axis_size("dp")) == 0:
+        return runtime.put_batch(arr)
+    return arr
+
+
+def _collect_texts(payload: Dict[str, Any]) -> Tuple[List[str], List[int]]:
+    """→ (texts, empty_rows); same drain-mode contract as map_summarize
+    (blank CSV cells become empty summaries, not model noise)."""
+    texts = payload.get("texts")
+    empty_rows: List[int] = []
+    if texts is None and "source_uri" in payload:
+        from agent_tpu.data.csv_index import read_shard_texts
+
+        texts = read_shard_texts(payload)  # ValueError → soft, I/O raises
+        empty_rows = [i for i, t in enumerate(texts) if not t]
+        if empty_rows:
+            texts = [t or " " for t in texts]
+    if not isinstance(texts, list) or not texts or not all(
+        isinstance(t, str) and t for t in texts
+    ):
+        raise ValueError(
+            "payload requires 'texts' (non-empty strings) or 'source_uri' "
+            "shard addressing"
+        )
+    return texts, empty_rows
+
+
+@register_op("summarize_encode")
+def run_encode(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    """Encoder stage: texts → encoder activations (the inter-stage wire)."""
+    t0 = time.perf_counter()
+    if not isinstance(payload, dict):
+        return bad_input("payload must be a dict")
+    try:
+        texts, empty_rows = _collect_texts(payload)
+    except ValueError as exc:
+        return bad_input(str(exc))
+    model_id, cfg = _resolve(payload)
+
+    import jax
+
+    runtime = _runtime(ctx)
+    from agent_tpu.ops.map_summarize import _stage_chunks
+
+    chunks = _stage_chunks(
+        runtime.axis_size("dp"), texts, cfg, num_beams=1, family="seq2seq",
+        model_id=model_id,
+    )
+    params = _get_params(runtime, model_id, cfg)
+    attn_fn = runtime.attention_fn()
+    out_chunks = []
+    for ids, lengths, n in chunks:
+        B, Ls = ids.shape
+
+        def build(Ls=Ls):
+            import jax.numpy as jnp
+
+            from agent_tpu.models import seq2seq
+
+            def run_enc(p, i, nlen):
+                mask = (jnp.arange(Ls)[None, :] < nlen[:, None]).astype(
+                    jnp.int32
+                )
+                enc = seq2seq.encode(
+                    p, i.astype(jnp.int32), mask, cfg, attn_fn=attn_fn
+                )
+                # f32 on the wire regardless of compute dtype: exact JSON
+                # round trip, and the decode stage re-casts to its own
+                # compute dtype (a bf16→f32 widening is lossless).
+                return enc.astype(jnp.float32)
+
+            return jax.jit(run_enc)
+
+        from agent_tpu.ops._model_common import cfg_key
+
+        fn = runtime.compiled(
+            ("summarize_encode", model_id, B, Ls, cfg_key(cfg)), build
+        )
+        enc = np.asarray(
+            fn(params, _put(runtime, ids), _put(runtime, lengths))
+        )
+        out_chunks.append({
+            "enc": enc.tolist(),
+            "lengths": np.asarray(lengths).astype(int).tolist(),
+            "n": int(n),
+        })
+    return {
+        "ok": True,
+        "op": "summarize_encode",
+        "model": model_id,
+        "device": runtime.platform,
+        "n_rows": len(texts),
+        "empty_rows": empty_rows,
+        "chunks": out_chunks,
+        "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
+    }
+
+
+def _encoded_inputs(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The encode-stage results to decode: ``encoded`` (one result object)
+    or ``partials`` (the controller's dep-gated materialization)."""
+    if "encoded" in payload:
+        sources = [payload["encoded"]]
+    elif "partials" in payload:
+        sources = payload["partials"]
+    else:
+        raise ValueError(
+            "payload requires 'encoded' (one summarize_encode result) or "
+            "dep-gated 'partials'"
+        )
+    if not isinstance(sources, list) or not sources:
+        raise ValueError("no encode-stage results to decode")
+    for src in sources:
+        if not (
+            isinstance(src, dict) and src.get("op") == "summarize_encode"
+            and isinstance(src.get("chunks"), list) and src["chunks"]
+        ):
+            raise ValueError(
+                "each encoded input must be a summarize_encode result "
+                "carrying 'chunks'"
+            )
+    return sources
+
+
+@register_op("summarize_decode")
+def run_decode(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    """Decoder stage: encoder activations → summaries. ``model_config`` /
+    ``model_path`` must match the encode stage's — the decoder resumes with
+    the same (deterministically seeded) weights."""
+    t0 = time.perf_counter()
+    if not isinstance(payload, dict):
+        return bad_input("payload must be a dict")
+    try:
+        sources = _encoded_inputs(payload)
+    except ValueError as exc:
+        return bad_input(str(exc))
+    max_new = payload.get("max_length", DEFAULT_MAX_LENGTH)
+    if isinstance(max_new, bool) or not isinstance(max_new, int) \
+            or max_new <= 0:
+        return bad_input("max_length must be a positive int")
+    model_id, cfg = _resolve(payload)
+    max_new = min(max_new, cfg.max_tgt_len)
+
+    import jax
+
+    runtime = _runtime(ctx)
+    params = _get_params(runtime, model_id, cfg)
+    from agent_tpu.models.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    summaries: List[str] = []
+    n_rows = 0
+    for src in sources:
+        src_summaries: List[str] = []
+        for chunk in src["chunks"]:
+            enc = np.asarray(chunk["enc"], dtype=np.float32)
+            lengths = np.asarray(chunk["lengths"], dtype=np.int32)
+            n = int(chunk["n"])
+            if enc.ndim != 3 or lengths.ndim != 1 \
+                    or enc.shape[0] != lengths.shape[0]:
+                return bad_input(
+                    f"malformed encode chunk: enc {enc.shape}, "
+                    f"lengths {lengths.shape}"
+                )
+            B, Ls, _d = enc.shape
+
+            def build(Ls=Ls):
+                import jax.numpy as jnp
+
+                from agent_tpu.models import seq2seq
+
+                def run_dec(p, e, nlen):
+                    mask = (jnp.arange(Ls)[None, :] < nlen[:, None]).astype(
+                        jnp.int32
+                    )
+                    toks, _lens = seq2seq.greedy_generate_from_encoded(
+                        p, e, mask, cfg, max_new
+                    )
+                    return toks
+
+                return jax.jit(run_dec)
+
+            from agent_tpu.ops._model_common import cfg_key
+
+            fn = runtime.compiled(
+                ("summarize_decode", model_id, B, Ls, max_new, cfg_key(cfg)),
+                build,
+            )
+            toks = np.asarray(
+                fn(params, _put(runtime, enc), _put(runtime, lengths))
+            )[:n]
+            src_summaries.extend(
+                tok.decode([t for t in row if t > 0]) for row in toks
+            )
+        for i in src.get("empty_rows") or []:
+            if 0 <= int(i) < len(src_summaries):
+                src_summaries[int(i)] = ""  # drain blanks stay blank
+        summaries.extend(src_summaries)
+        n_rows += len(src_summaries)
+    return {
+        "ok": True,
+        "op": "summarize_decode",
+        "model": model_id,
+        "device": runtime.platform,
+        "n_rows": n_rows,
+        "summaries": summaries,
+        "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
+    }

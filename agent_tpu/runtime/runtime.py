@@ -24,6 +24,46 @@ from agent_tpu.runtime.mesh import build_mesh
 from agent_tpu.utils.logging import log
 
 
+def parse_chip_slice(spec: str) -> Tuple[int, int]:
+    """``"start:count"`` → ``(start, count)``, strictly validated.
+
+    The slice grammar is deliberately tiny (two non-negative ints, count
+    ≥ 1): a fleet launcher computes these, and a typo must fail the agent at
+    boot — an agent silently running on the wrong chips would corrupt the
+    whole fleet's placement arithmetic.
+    """
+    parts = spec.split(":")
+    if len(parts) != 2:
+        raise ValueError(
+            f"CHIP_SLICE must be 'start:count', got {spec!r}"
+        )
+    try:
+        start, count = int(parts[0]), int(parts[1])
+    except ValueError as exc:
+        raise ValueError(
+            f"CHIP_SLICE must be 'start:count' ints, got {spec!r}"
+        ) from exc
+    if start < 0 or count < 1:
+        raise ValueError(
+            f"CHIP_SLICE needs start >= 0 and count >= 1, got {spec!r}"
+        )
+    return start, count
+
+
+def apply_chip_slice(devices: Sequence, spec: str) -> list:
+    """The ``[start, start+count)`` slice of ``devices`` — the device-pinning
+    primitive of fleet mode (ISSUE 7). Out-of-range slices raise: truncating
+    silently would run a 2-chip agent on 1 chip and skew every per-chip
+    number derived from its leases."""
+    start, count = parse_chip_slice(spec)
+    if start + count > len(devices):
+        raise ValueError(
+            f"CHIP_SLICE {spec!r} wants devices [{start}, {start + count}) "
+            f"but only {len(devices)} are visible"
+        )
+    return list(devices)[start:start + count]
+
+
 def detect_platform(tpu_disabled: bool = False) -> str:
     """The platform we can *prove* we have: 'tpu' only if jax.devices() shows
     TPU devices (and the TPU_DISABLED kill-switch is off); else jax's default
@@ -71,6 +111,12 @@ class TpuRuntime:
         if devices is None:
             platform = detect_platform(self.config.tpu_disabled)
             devices = jax.devices(platform)
+            if self.config.chip_slice:
+                # Device-pinned fleet member (ISSUE 7): own only this
+                # process's slice of the host's devices. Explicit `devices`
+                # callers already chose, so the slice applies only to the
+                # discovery path.
+                devices = apply_chip_slice(devices, self.config.chip_slice)
         self.devices = list(devices)
         self.platform = self.devices[0].platform
         if self.config.profile_port:
@@ -308,6 +354,11 @@ class TpuRuntime:
             "executable_cache": self.cache.stats(),
             "models_resident": sorted(self._model_ids_snapshot()),
         }
+        if self.config.chip_slice:
+            # Fleet mode (ISSUE 7): which slice of the host this runtime
+            # owns — rides the lease telemetry so the controller's fleet
+            # view can attribute chips per agent.
+            out["chip_slice"] = self.config.chip_slice
         try:
             mem = self.devices[0].memory_stats()
             if mem:

@@ -35,6 +35,12 @@ Legs (the ``legs`` object in the output line):
                      classify-only (comparable to the pure-op number) and
                      **mixed classify+summarize** (the BASELINE.json north-star
                      job shape at bench scale).
+- ``drain_multichip`` — the swarm across N chips (ISSUE 7): a fleet of N
+                     device-pinned agent subprocesses and a dp=N mesh agent
+                     drain the same sharded job on the forced-host CPU smoke
+                     shape, bit-identical to the 1-chip reference, with
+                     ``scaling_efficiency`` = rows/sec at N ÷ N·rows/sec at 1
+                     (asserted ≥ 0.8 when the host has ≥ N cores).
 """
 
 from __future__ import annotations
@@ -86,6 +92,22 @@ TRAIN_STEPS = 8
 DRAIN_ROWS = 65_536
 DRAIN_SHARD_SIZE = 8192
 DRAIN_SUMMARIZE_ROWS = 16_384
+# Multi-chip drain leg (ISSUE 7): N device-pinned agent subprocesses (and a
+# dp=N mesh agent) drain the same sharded job on the forced-host CPU smoke
+# shape — the scaling demonstration runs on virtual chips so the leg is
+# recordable on any host; real-TPU fleets use scripts/fleet.py directly.
+MULTICHIP_AGENTS = 4
+MULTICHIP_ROWS = 16_384
+MULTICHIP_SHARD = 512
+MULTICHIP_MODEL = {
+    "d_model": 32, "n_heads": 4, "n_layers": 1, "d_ff": 64,
+    "max_len": 64, "dtype": "float32", "n_classes": 16,
+}
+# Near-linear bar: rows/sec at N agents ≥ 0.8 · N · rows/sec at 1 agent.
+# Asserted only when the host has at least one core per agent — on fewer
+# cores the fleet can only conserve throughput, and "0.25 at 4 agents on 1
+# core" is the expected physics, not a regression.
+MULTICHIP_SCALING_FLOOR = 0.8
 # Summarize throughput scales with decode rows in flight: measured 4,980 /
 # 6,588 / 7,779 / 8,093 rows/s at payload 1k/2k/4k/8k (chained ≤1024-row
 # programs at the time), 9,132 as ONE B=8192 program — per-step decode
@@ -1139,6 +1161,158 @@ def _bench_drain_binary(runtime, n_rows: int = DRAIN_ROWS,
     return leg
 
 
+def _fleet_drain_mode(
+    csv_path, extra, warm_file, *, n_agents, devices_per_agent,
+    mesh_shape, name_prefix, log_dir, rows, shard_size,
+):
+    """One fleet/mesh drain over real HTTP → (rows_per_sec, per-agent shard
+    counts, results keyed by start_row). Children are spawned, warmed, and
+    READY (first controller poll seen) before the timed submit, so
+    per-process compile cost stays outside the window — the same warm-
+    exclusion methodology as every other drain leg."""
+    from agent_tpu.agent import fleet
+    from agent_tpu.config import SchedConfig
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+
+    # Fair policy on purpose: idle-preference + queue_depth-aware grants
+    # are what spread shards across the fleet (ISSUE 7 tentpole a).
+    controller = Controller(
+        lease_ttl_sec=600.0, sched=SchedConfig(policy="fair")
+    )
+    server = ControllerServer(controller).start()
+    handle = fleet.spawn_fleet(
+        n_agents, devices_per_agent,
+        controller_url=server.url, tasks="map_classify_tpu",
+        platform="cpu", name_prefix=name_prefix, mesh_shape=mesh_shape,
+        warm_file=warm_file, log_dir=log_dir,
+        extra_env={
+            "IDLE_SLEEP_SEC": "0.02",
+            # One virtual chip = one core's worth of BLAS: a 1-agent
+            # reference that borrows the whole host's thread pool would
+            # deflate every scaling ratio derived from it.
+            "OMP_NUM_THREADS": "1",
+            "OPENBLAS_NUM_THREADS": "1",
+        },
+    )
+    try:
+        assert fleet.wait_for_agents(
+            controller.agents_summary, handle.names, timeout=300.0,
+            fleet=handle,
+        ), (
+            f"fleet {name_prefix} not ready "
+            f"(failures={handle.poll_failures()})"
+        )
+        t0 = time.perf_counter()
+        shard_ids, _ = controller.submit_csv_job(
+            csv_path, total_rows=rows, shard_size=shard_size,
+            map_op="map_classify_tpu", extra_payload=extra,
+        )
+        deadline = time.monotonic() + 600.0
+        while not controller.drained():
+            assert time.monotonic() < deadline, (
+                f"fleet {name_prefix} drain stuck: {controller.counts()}"
+            )
+            assert not handle.poll_failures(), (
+                f"fleet member died: {handle.poll_failures()}"
+            )
+            time.sleep(0.02)
+        wall = time.perf_counter() - t0
+        counts = controller.counts()
+        assert counts.get("failed", 0) == 0, counts
+        per_agent = {name: 0 for name in handle.names}
+        results = {}
+        for jid in shard_ids:
+            snap = controller.job_snapshot(jid)
+            r = snap["result"]
+            assert isinstance(r, dict) and r.get("ok") is True, (jid, r)
+            results[controller.job(jid).payload["start_row"]] = (
+                r["indices"], r["scores"]
+            )
+            if snap["agent"] in per_agent:
+                per_agent[snap["agent"]] += 1
+        return rows / wall, per_agent, results
+    finally:
+        handle.stop()
+        server.stop()
+
+
+def _bench_drain_multichip(n_rows: int = MULTICHIP_ROWS,
+                           shard_size: int = MULTICHIP_SHARD):
+    """``drain_multichip`` leg (ISSUE 7): the swarm across N chips, both
+    ways — a fleet of N single-chip agent processes (device-pinned via
+    ``CHIP_SLICE``) and one dp=N mesh agent — against the 1-chip reference
+    drain. Records per-mode rows/sec, ``n_chips``, per-agent shard counts,
+    and ``scaling_efficiency`` = rows/sec at N ÷ (N × rows/sec at 1),
+    asserting ≥ MULTICHIP_SCALING_FLOOR at N agents when the host has the
+    cores to scale. Bit-identity of fleet and mesh results vs the 1-chip
+    reference is always asserted."""
+    import tempfile
+
+    n = MULTICHIP_AGENTS
+    extra = {"text_field": "text", "allow_fallback": False,
+             "result_format": "columnar",
+             "model_config": dict(MULTICHIP_MODEL), "topk": 5}
+    leg: dict = {"rows": n_rows, "agents": n, "n_chips": n}
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "multichip.csv")
+        with open(path, "w") as f:
+            f.write("id,text\n")
+            for i in range(n_rows):
+                f.write(f'{i},"drain record {i} with a payload of text"\n')
+        warm_file = os.path.join(td, "warm.json")
+        with open(warm_file, "w") as f:
+            json.dump([{
+                "op": "map_classify_tpu",
+                "payload": {**extra, "source_uri": path, "start_row": 0,
+                            "shard_size": shard_size},
+            }], f)
+        results = {}
+        for mode, n_agents, dev_per, mesh in (
+            ("agents_1", 1, 1, ""),
+            (f"agents_{n}", n, 1, ""),
+            (f"mesh_dp{n}", 1, n, f"dp={n}"),
+        ):
+            rate, per_agent, res = _fleet_drain_mode(
+                path, extra, warm_file,
+                n_agents=n_agents, devices_per_agent=dev_per,
+                mesh_shape=mesh, name_prefix=f"bench-{mode}",
+                log_dir=os.path.join(td, f"logs_{mode}"),
+                rows=n_rows, shard_size=shard_size,
+            )
+            leg[f"{mode}_rows_per_sec"] = round(rate, 1)
+            results[mode] = res
+            if n_agents > 1:
+                leg["per_agent_shards"] = per_agent
+                assert all(v > 0 for v in per_agent.values()), (
+                    f"agent(s) got zero shards: {per_agent}"
+                )
+        for mode in (f"agents_{n}", f"mesh_dp{n}"):
+            assert results[mode] == results["agents_1"], (
+                f"{mode} drain diverged from the 1-chip reference"
+            )
+        leg["bit_identical"] = True
+        eff = (
+            leg[f"agents_{n}_rows_per_sec"]
+            / (n * leg["agents_1_rows_per_sec"])
+        )
+        leg["scaling_efficiency"] = round(eff, 3)
+        leg["host_cores"] = os.cpu_count()
+        if (os.cpu_count() or 1) >= n:
+            assert eff >= MULTICHIP_SCALING_FLOOR, (
+                f"scaling_efficiency {eff:.3f} < {MULTICHIP_SCALING_FLOOR} "
+                f"at {n} agents on {os.cpu_count()} cores"
+            )
+        else:
+            # Fewer cores than agents: the bar is physically unreachable;
+            # record why instead of asserting fiction.
+            leg["scaling_gated"] = (
+                f"{os.cpu_count()} cores < {n} agents; floor not asserted"
+            )
+        leg["rows_per_sec"] = leg[f"agents_{n}_rows_per_sec"]
+    return leg
+
+
 def main() -> int:
     from agent_tpu.runtime.runtime import get_runtime
 
@@ -1154,7 +1328,14 @@ def main() -> int:
         windows=NOISY_WINDOWS,
     )
     legs["flagship"] = flagship
-    rows_per_sec_per_chip = flagship["rows_per_sec"] / n_chips
+    # Per-chip normalization from the devices the LEG actually used
+    # (ISSUE 7 satellite): real TPU legs engage the whole mesh; on host
+    # backends the forced virtual devices share one CPU and are not chips —
+    # dividing the host rate by 8 fabricated per-chip throughput. Fleet
+    # legs carry their own n_chips.
+    flagship_chips = n_chips if runtime.platform == "tpu" else 1
+    flagship["n_chips_used"] = flagship_chips
+    rows_per_sec_per_chip = flagship["rows_per_sec"] / flagship_chips
 
     for name, fn in (
         ("bert_base", lambda: _bench_bert_base(runtime)),
@@ -1210,6 +1391,10 @@ def main() -> int:
     for name, fn in (
         ("drain_staged_parallel", lambda: _bench_drain_staged(runtime)),
         ("drain_binary_wire", lambda: _bench_drain_binary(runtime)),
+        # Multi-chip swarm drain (ISSUE 7): fleet of N pinned agent
+        # processes + dp=N mesh agent vs the 1-chip reference, scaling
+        # efficiency asserted when the host has the cores.
+        ("drain_multichip", _bench_drain_multichip),
     ):
         try:
             legs[name] = fn()
@@ -1240,6 +1425,9 @@ def main() -> int:
                     "drain_rows": DRAIN_ROWS,
                     "drain_shard_size": DRAIN_SHARD_SIZE,
                     "drain_summarize_rows": DRAIN_SUMMARIZE_ROWS,
+                    "multichip_agents": MULTICHIP_AGENTS,
+                    "multichip_rows": MULTICHIP_ROWS,
+                    "multichip_shard_size": MULTICHIP_SHARD,
                 },
                 "metric": "map_classify_tpu rows/sec/chip",
                 "value": round(rows_per_sec_per_chip, 1),
@@ -1296,6 +1484,13 @@ def main() -> int:
                 .get("bytes_per_row"),
                 "wire_shrink_x": legs["drain_binary_wire"]
                 .get("wire_shrink_x"),
+                # Multi-chip flat fields (ISSUE 7): the trajectory finally
+                # records n_chips > 1 and the scaling it buys.
+                "multichip_rows_per_sec": legs["drain_multichip"]
+                .get("rows_per_sec"),
+                "multichip_scaling_efficiency": legs["drain_multichip"]
+                .get("scaling_efficiency"),
+                "multichip_n_chips": legs["drain_multichip"].get("n_chips"),
             }
         ),
         flush=True,

@@ -10,9 +10,18 @@ Run on the TPU host:
     python scripts/drain_at_scale.py --rows 10000000 \
         --workdir /tmp/drain10m --report DRAIN_AT_SCALE.json
 
+Multi-chip legs (ISSUE 7): ``--agents N`` drains through a fleet of N
+device-pinned agent subprocesses (``agent_tpu/agent/fleet.py``; on TPU
+hardware pass ``--fleet-platform tpu`` so each member owns disjoint chips
+via TPU_VISIBLE_DEVICES); ``--mesh-dp N`` drains through ONE agent whose
+runtime executes dp-sharded over an N-device mesh. Both record per-agent
+shard counts and the trace-derived stage/execute overlap per agent, and
+exit nonzero if any agent got zero shards.
+
 The report JSON records wall time, per-op rows/sec and device-busy seconds,
-shard counts, retry/failure counts, and sink row totals — the artifact
-PARITY.md cites for the "drains a 10M-row classify+summarize job" sentence.
+shard counts, retry/failure counts, n_chips, and sink row totals — the
+artifact PARITY.md cites for the "drains a 10M-row classify+summarize job"
+sentence.
 """
 
 from __future__ import annotations
@@ -53,12 +62,73 @@ def build_csv(path: str, n_rows: int) -> None:
           f"{time.perf_counter() - t0:.0f}s", flush=True)
 
 
+def warm_payload_specs(csv_path, n_rows, classify_extra, summarize_extra,
+                       warm_out):
+    """``[{op, payload}]`` covering BOTH length buckets of BOTH ops (row ids
+    grow 1→7 digits across the dataset, crossing a bucket boundary) — the
+    single warm-shape definition shared by the in-process warm submissions
+    and the fleet members' local pre-lease warmup."""
+    specs = []
+    for op_name, shard, extra in (
+        ("map_classify_tpu", CLASSIFY_SHARD, classify_extra),
+        ("map_summarize", SUMMARIZE_SHARD, summarize_extra),
+    ):
+        starts = [0]
+        tail = max(0, n_rows - min(shard, n_rows))
+        if tail > 0:
+            starts.append(tail)
+        for start in starts:
+            specs.append({"op": op_name, "payload": {
+                **extra,
+                "source_uri": csv_path,
+                "start_row": start,
+                "shard_size": min(shard, n_rows - start),
+                "output_uri": warm_out,
+            }})
+    return specs
+
+
+def per_agent_shards(controller, job_ids):
+    """{agent: executed shard count} over ``job_ids`` (succeeded jobs)."""
+    counts = {}
+    for jid in job_ids:
+        agent = controller.job_snapshot(jid)["agent"]
+        if agent:
+            counts[agent] = counts.get(agent, 0) + 1
+    return counts
+
+
+def overlap_report(server_url):
+    """(fleet overlap, per-agent overlap) from the trace window; either may
+    be None when tracing is off — callers decide how loud to be."""
+    from agent_tpu.obs.scrape import (
+        collect_trace_spans,
+        overlap_by_process,
+        overlap_from_spans,
+    )
+
+    spans = collect_trace_spans(server_url)
+    if spans is None:
+        return None, None
+    return overlap_from_spans(spans), overlap_by_process(spans)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=10_000_000)
     ap.add_argument("--workdir", default="/tmp/drain_at_scale")
     ap.add_argument("--report", default="DRAIN_AT_SCALE.json")
     ap.add_argument("--progress-sec", type=float, default=60.0)
+    # Multi-chip legs (ISSUE 7): a fleet of N pinned agent processes, or
+    # one dp=N mesh agent. Default (1, 0) keeps the classic in-process leg.
+    ap.add_argument("--agents", type=int, default=1)
+    ap.add_argument("--devices-per-agent", type=int, default=1)
+    ap.add_argument("--mesh-dp", type=int, default=0,
+                    help="run ONE agent with MESH_SHAPE=dp=N (N devices)")
+    ap.add_argument("--fleet-platform", choices=("cpu", "tpu"),
+                    default="cpu",
+                    help="fleet pinning mode: cpu = forced-host virtual "
+                         "devices; tpu = hardware chips")
     # bf16 is the default: W8A8's dynamic activation quantization costs
     # more than the MXU saves on [B, 256]-thin decode matmuls (measured
     # 3,983 int8 vs 4,980 bf16 rows/s at B=1024); int8 pays off on
@@ -66,6 +136,9 @@ def main() -> int:
     ap.add_argument("--summarize-quant", default="none",
                     choices=("int8", "none"))
     args = ap.parse_args()
+
+    if args.agents > 1 or args.mesh_dp > 1 or args.devices_per_agent > 1:
+        return main_fleet(args)
 
     import requests
 
@@ -120,28 +193,15 @@ def main() -> int:
         # grow 1→7 digits across the dataset, crossing a length-bucket
         # boundary, so warm shards come from BOTH ends of the CSV — per-op
         # tail positions, so each op warms its own full shard shape.
+        # Warm results go to a scratch sink dir: the real sinks must contain
+        # EXACTLY the timed job's shards for the post-run validation.
         warm_out = os.path.join(args.workdir, "warm_out")
-        n_warm = 0
-        for op_name, shard, extra in (
-            ("map_classify_tpu", CLASSIFY_SHARD, classify_extra),
-            ("map_summarize", SUMMARIZE_SHARD, summarize_extra),
-        ):
-            starts = [0]
-            tail = max(0, args.rows - min(shard, args.rows))
-            if tail > 0:
-                starts.append(tail)
-            for start in starts:
-                controller.submit(op_name, {
-                    **extra,
-                    "source_uri": csv_path,
-                    "start_row": start,
-                    "shard_size": min(shard, args.rows - start),
-                    # Warm results go to a scratch sink dir: the real sinks
-                    # must contain EXACTLY the timed job's shards for the
-                    # post-run contiguity validation.
-                    "output_uri": warm_out,
-                })
-                n_warm += 1
+        warm_specs = warm_payload_specs(
+            csv_path, args.rows, classify_extra, summarize_extra, warm_out
+        )
+        for spec in warm_specs:
+            controller.submit(spec["op"], spec["payload"])
+        n_warm = len(warm_specs)
         agent.running = True
         warm_done = {}
 
@@ -315,6 +375,17 @@ def main() -> int:
                 f"{overlap['execute_p50_ms']:.1f} ms)",
                 flush=True,
             )
+            # Per-agent attribution (ISSUE 7 satellite): trivially one
+            # entry here; the fleet leg reports one per member.
+            from agent_tpu.obs.scrape import stage_execute_overlap_by_agent
+
+            overlap_by_agent = stage_execute_overlap_by_agent(server.url)
+        else:
+            overlap_by_agent = None
+        agent_shards = per_agent_shards(
+            controller,
+            [j for j in controller.results() if j not in warm_jobs],
+        )
 
     report = {
         "rows": args.rows,
@@ -345,6 +416,11 @@ def main() -> int:
         # fraction of stage wall time the staging pool hid behind device
         # execute, with per-phase p50s; None only with TRACE_ENABLED=0.
         "stage_execute_overlap": overlap,
+        # Multi-chip accounting (ISSUE 7): who executed what, and each
+        # member's own overlap picture.
+        "mode": "single",
+        "per_agent_shards": agent_shards,
+        "stage_execute_overlap_by_agent": overlap_by_agent,
         "classify": {
             "shard_size": CLASSIFY_SHARD,
             "rows_written": rows_written["map_classify_tpu"],
@@ -375,7 +451,234 @@ def main() -> int:
         and not_ok == 0
         and rows_written["map_classify_tpu"] == args.rows
         and rows_written["map_summarize"] == args.rows
+        # Zero-shard agents fail the drain (ISSUE 7): an idle member means
+        # placement is broken even when the rows all landed.
+        and bool(agent_shards)
+        and all(v > 0 for v in agent_shards.values())
     )
+    print("DRAIN", "OK" if ok else "FAILED", flush=True)
+    return 0 if ok else 1
+
+
+def main_fleet(args) -> int:
+    """Multi-chip leg: the same classify+summarize drain executed by a
+    fleet of pinned agent subprocesses (``--agents N``) or one dp=N mesh
+    agent (``--mesh-dp N``), timed post-warmup like the in-process leg."""
+    from agent_tpu.agent import fleet as fleet_mod
+    from agent_tpu.config import SchedConfig
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+    from agent_tpu.obs.scrape import (
+        fetch_metrics_text,
+        op_phase_seconds,
+        slowest_trace,
+    )
+    from agent_tpu.obs import trace as obs_trace
+    from agent_tpu.obs.trace import phase_breakdown
+
+    if args.mesh_dp > 1 and args.agents > 1:
+        print("--agents and --mesh-dp are alternative modes; pick one",
+              flush=True)
+        return 2
+    mode = "mesh" if args.mesh_dp > 1 else "fleet"
+    n_agents = 1 if mode == "mesh" else args.agents
+    dev_per = args.mesh_dp if mode == "mesh" else args.devices_per_agent
+    mesh_shape = f"dp={args.mesh_dp}" if mode == "mesh" else ""
+
+    os.makedirs(args.workdir, exist_ok=True)
+    csv_path = os.path.join(args.workdir, f"drain_{args.rows}.csv")
+    classify_out = os.path.join(args.workdir, "classify_out")
+    summarize_out = os.path.join(args.workdir, "summarize_out")
+    build_csv(csv_path, args.rows)
+
+    classify_extra = {
+        "text_field": "text", "allow_fallback": False,
+        "output_uri": classify_out,
+    }
+    summarize_extra = {
+        "text_field": "text", "allow_fallback": False,
+        "max_length": SUMMARIZE_MAX_NEW, "output_uri": summarize_out,
+        **(
+            {"model_config": {"quant": args.summarize_quant}}
+            if args.summarize_quant != "none" else {}
+        ),
+    }
+    # Fleet members warm LOCALLY (pre-lease, both ops × both length
+    # buckets) — compile is per-process and must stay out of the window.
+    warm_file = os.path.join(args.workdir, "fleet_warm.json")
+    with open(warm_file, "w") as f:
+        json.dump(warm_payload_specs(
+            csv_path, args.rows, classify_extra, summarize_extra,
+            os.path.join(args.workdir, "warm_out"),
+        ), f)
+
+    controller = Controller(
+        lease_ttl_sec=600.0, sched=SchedConfig(policy="fair")
+    )
+    drain_ops = ("map_classify_tpu", "map_summarize")
+    with ControllerServer(controller) as server:
+        handle = fleet_mod.spawn_fleet(
+            n_agents, dev_per,
+            controller_url=server.url,
+            tasks="map_classify_tpu,map_summarize",
+            platform=args.fleet_platform, name_prefix="drain",
+            mesh_shape=mesh_shape, warm_file=warm_file,
+            log_dir=os.path.join(args.workdir, "fleet_logs"),
+            extra_env={"IDLE_SLEEP_SEC": "0.02"},
+        )
+        try:
+            if not fleet_mod.wait_for_agents(
+                controller.agents_summary, handle.names, timeout=1800.0,
+                fleet=handle,
+            ):
+                print(
+                    f"DRAIN FAILED: fleet not ready (failures="
+                    f"{handle.poll_failures()}); see "
+                    f"{args.workdir}/fleet_logs", flush=True,
+                )
+                return 1
+            print(f"fleet ready: {handle.names} "
+                  f"({mode}, {dev_per} device(s) each)", flush=True)
+            pre_text = fetch_metrics_text(server.url)
+            span_pre = (
+                op_phase_seconds(pre_text, drain_ops)
+                if pre_text is not None else None
+            )
+            t_start = time.perf_counter()
+            shard_ids = []
+            for op_name, shard, extra in (
+                ("map_classify_tpu", CLASSIFY_SHARD, classify_extra),
+                ("map_summarize", SUMMARIZE_SHARD, summarize_extra),
+            ):
+                ids, _ = controller.submit_csv_job(
+                    csv_path, total_rows=args.rows, shard_size=shard,
+                    map_op=op_name, extra_payload=extra,
+                )
+                shard_ids.extend(ids)
+            n_shards = len(shard_ids)
+            print(f"submitted {n_shards} shards "
+                  f"({args.rows} rows x 2 ops)", flush=True)
+            last = 0.0
+            while not controller.drained():
+                time.sleep(1.0)
+                if handle.poll_failures():
+                    print(
+                        f"DRAIN FAILED: fleet member died "
+                        f"({handle.poll_failures()})", flush=True,
+                    )
+                    return 1
+                now = time.perf_counter()
+                if now - last >= args.progress_sec:
+                    last = now
+                    print(
+                        f"[{now - t_start:7.0f}s] "
+                        f"{json.dumps(controller.counts())}", flush=True,
+                    )
+            wall = time.perf_counter() - t_start
+
+            counts = dict(controller.counts())
+            rows_written = {"map_classify_tpu": 0, "map_summarize": 0}
+            not_ok = 0
+            from agent_tpu.utils.spans import result_op
+
+            for jid in shard_ids:
+                r = controller.job_snapshot(jid)["result"]
+                if not isinstance(r, dict) or r.get("ok") is not True:
+                    not_ok += 1
+                    continue
+                op = result_op(r)
+                if op in rows_written:
+                    rows_written[op] += int(r.get("rows_written", 0))
+            post_text = fetch_metrics_text(server.url)
+            busy_s = {}
+            if span_pre is not None and post_text is not None:
+                span_post = op_phase_seconds(post_text, drain_ops)
+                busy_s = {
+                    op: span_post[op] - span_pre[op] for op in drain_ops
+                }
+            agent_shards = per_agent_shards(controller, shard_ids)
+            # Fleet chip accounting: every member pushed its runtime
+            # describe() through the lease metrics channel.
+            n_chips = 0
+            platform = None
+            for entry in controller.agents_summary().values():
+                dev = (entry.get("metrics") or {}).get("device") or {}
+                n_chips += int(dev.get("n_devices") or 0)
+                platform = dev.get("platform") or platform
+            trace_line = None
+            overlap = None
+            overlap_by_agent = None
+            if obs_trace.enabled():
+                worst = slowest_trace(server.url)
+                if worst is None:
+                    print("DRAIN FAILED: trace path broken for the fleet "
+                          "drain", flush=True)
+                    return 1
+                trace_line = phase_breakdown(worst)
+                print(f"[slowest shard] {trace_line}", flush=True)
+                overlap, overlap_by_agent = overlap_report(server.url)
+                if not overlap_by_agent:
+                    print("DRAIN FAILED: no per-agent stage/execute "
+                          "overlap assembled", flush=True)
+                    return 1
+                for name, o in sorted(overlap_by_agent.items()):
+                    print(
+                        f"[overlap {name}] {o['overlap_ratio']:.3f} hidden "
+                        f"(stage p50 {o['stage_p50_ms']:.1f} ms, execute "
+                        f"p50 {o['execute_p50_ms']:.1f} ms)", flush=True,
+                    )
+        finally:
+            handle.stop()
+
+    report = {
+        "rows": args.rows,
+        "ops": list(drain_ops),
+        "mode": mode,
+        "agents": n_agents,
+        "devices_per_agent": dev_per,
+        "wall_s": round(wall, 1),
+        "shards": n_shards,
+        "counts": counts,
+        "non_ok_results": not_ok,
+        "total_rows_per_sec": round(2 * args.rows / wall, 1),
+        "per_agent_shards": agent_shards,
+        "slowest_trace": trace_line,
+        "stage_execute_overlap": overlap,
+        "stage_execute_overlap_by_agent": overlap_by_agent,
+        "classify": {
+            "shard_size": CLASSIFY_SHARD,
+            "rows_written": rows_written["map_classify_tpu"],
+            "device_span_s": round(busy_s.get("map_classify_tpu", 0.0), 1),
+        },
+        "summarize": {
+            "shard_size": SUMMARIZE_SHARD,
+            "max_new_tokens": SUMMARIZE_MAX_NEW,
+            "quant": args.summarize_quant,
+            "rows_written": rows_written["map_summarize"],
+            "device_span_s": round(busy_s.get("map_summarize", 0.0), 1),
+        },
+        "platform": platform,
+        "n_chips": n_chips,
+    }
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report), flush=True)
+
+    zero = [a for a, v in agent_shards.items() if v == 0]
+    # An agent that executed nothing never appears in the per-job agent
+    # fields at all — the absent members are the real zero-shard signal.
+    missing = [a for a in handle.names if a not in agent_shards]
+    ok = (
+        counts.get("failed", 0) == 0
+        and not_ok == 0
+        and rows_written["map_classify_tpu"] == args.rows
+        and rows_written["map_summarize"] == args.rows
+        and n_chips >= n_agents * dev_per
+        and not zero
+        and not missing  # an agent that executed nothing never appears
+    )
+    if zero or missing:
+        print(f"ZERO-SHARD AGENTS: {zero + missing}", flush=True)
     print("DRAIN", "OK" if ok else "FAILED", flush=True)
     return 0 if ok else 1
 

@@ -132,6 +132,56 @@ class TestMetricsRegistry:
         assert c.value(t="x") == 8000
 
 
+class TestFleetMetricsHygiene:
+    """ISSUE 7 satellite: once ≥ 2 agents push snapshots, per-agent load
+    series (gauges + device busy/idle counters) must carry an ``agent``
+    label next to the unlabeled fleet merge — a merged-only view collapses
+    the fleet into one number and hides a starving member."""
+
+    @staticmethod
+    def _agent_snapshot(n):
+        r = MetricsRegistry()
+        r.gauge("queue_depth", "q", ("queue",)).set(n, queue="staged")
+        r.counter("device_busy_seconds_total", "b").inc(n)
+        r.counter("tasks_total", "t", ("op",)).inc(n, op="echo")
+        return r.snapshot()
+
+    def test_single_agent_keeps_legacy_unlabeled_shape(self):
+        c = Controller()
+        c.lease("a1", {"ops": []}, max_tasks=0,
+                metrics={"obs": self._agent_snapshot(1)})
+        text = c.metrics_text()
+        assert validate_exposition(text) == []
+        for labels, _ in parse_exposition(text)["queue_depth"]:
+            assert "agent" not in labels
+
+    def test_two_agents_get_agent_labeled_gauges_plus_fleet_merge(self):
+        c = Controller()
+        c.lease("a1", {"ops": []}, max_tasks=0,
+                metrics={"obs": self._agent_snapshot(1)})
+        c.lease("a2", {"ops": []}, max_tasks=0,
+                metrics={"obs": self._agent_snapshot(2)})
+        text = c.metrics_text()
+        assert validate_exposition(text) == []
+        parsed = parse_exposition(text)
+
+        def by_agent(name, **want):
+            out = {}
+            for labels, value in parsed[name]:
+                if all(labels.get(k) == v for k, v in want.items()):
+                    out[labels.get("agent", "")] = value
+            return out
+
+        # Gauge: per-agent values visible AND the unlabeled fleet sum.
+        qd = by_agent("queue_depth", queue="staged")
+        assert qd == {"": 3.0, "a1": 1.0, "a2": 2.0}
+        busy = by_agent("device_busy_seconds_total")
+        assert busy == {"": 3.0, "a1": 1.0, "a2": 2.0}
+        # Ordinary counters stay merged-only: no per-agent duplication.
+        tasks = by_agent("tasks_total", op="echo")
+        assert tasks == {"": 3.0}
+
+
 class TestScrapeHelpers:
     def test_op_phase_seconds_sums_fleet_series_only(self):
         from agent_tpu.obs.scrape import op_phase_seconds
@@ -155,6 +205,32 @@ class TestScrapeHelpers:
         from agent_tpu.obs.scrape import op_phase_seconds
 
         assert op_phase_seconds("not prometheus {{{", ("x",)) == {"x": 0.0}
+
+    def test_overlap_by_process_groups_agents(self):
+        """ISSUE 7: per-agent overlap attribution — each agent's stage
+        spans measured against ITS OWN execute spans, controller spans
+        skipped."""
+        from agent_tpu.obs.scrape import overlap_by_process
+
+        def span(name, proc, start, dur_ms):
+            return {"name": name, "process": proc, "start_wall": start,
+                    "duration_ms": dur_ms}
+
+        spans = [
+            # agent a: stage fully hidden under execute
+            span("execute", "agent:a", 0.0, 1000.0),
+            span("stage", "agent:a", 0.2, 200.0),
+            # agent b: stage entirely OUTSIDE its execute window
+            span("execute", "agent:b", 5.0, 1000.0),
+            span("stage", "agent:b", 7.0, 200.0),
+            span("apply", "controller", 0.0, 1.0),
+        ]
+        out = overlap_by_process(spans)
+        assert set(out) == {"a", "b"}
+        assert out["a"]["overlap_ratio"] == 1.0
+        assert out["b"]["overlap_ratio"] == 0.0
+        # An agent's stage must NOT count as hidden under another agent's
+        # execute — that is the whole point of the per-process grouping.
 
 
 class TestFlightRecorder:

@@ -441,6 +441,145 @@ class TestPlacement:
 
 
 # ---------------------------------------------------------------------------
+# Placement at scale (ISSUE 7): multiple agents leasing CONCURRENTLY from
+# one in-process controller — the fleet-mode control-plane scenario.
+# ---------------------------------------------------------------------------
+
+class TestPlacementAtScale:
+    N_SHARDS = 48
+
+    def _fleet_agent(self, controller, name, depth_fn=None):
+        """A real Agent over LoopbackSession running a slowed echo op, so
+        thread interleaving actually happens between leases."""
+        import time as _time
+
+        from agent_tpu.agent.app import Agent
+        from agent_tpu.chaos import LoopbackSession
+        from agent_tpu.config import AgentConfig, Config
+
+        agent = Agent(
+            config=Config(agent=AgentConfig(
+                controller_url="http://loopback", agent_name=name,
+                tasks=("echo",), idle_sleep_sec=0.0,
+            )),
+            session=LoopbackSession(controller),
+        )
+        agent._profile = {"tier": "test"}
+
+        def slow_echo(payload, ctx=None):
+            _time.sleep(0.002)
+            return {"ok": True, "echo": dict(payload or {})}
+
+        agent.handlers = {"echo": slow_echo}
+        if depth_fn is not None:
+            agent.staged_depth_fn = depth_fn
+        return agent
+
+    def _drain_with_threads(self, controller, agents, deadline_sec=60.0):
+        import threading
+        import time as _time
+
+        start = threading.Barrier(len(agents))
+
+        def run(agent):
+            start.wait()
+            end = _time.monotonic() + deadline_sec
+            while not controller.drained() and _time.monotonic() < end:
+                agent.step()
+
+        threads = [
+            threading.Thread(target=run, args=(a,), daemon=True)
+            for a in agents
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=deadline_sec + 10)
+        assert controller.drained(), controller.counts()
+
+    def test_two_concurrent_agents_share_the_drain_bit_identically(self):
+        """Both members of a 2-agent fleet receive shards, and the drained
+        results equal the single-agent drain's — order-insensitive (keyed
+        by job id), agent-insensitive (same op, same payloads)."""
+        def submit_all(c):
+            ids = []
+            for i in range(self.N_SHARDS):
+                ids.append(c.submit(
+                    "echo", {"x": i}, job_id=f"shard-{i}-fleettest"
+                ))
+            return ids
+
+        def payload_part(result):
+            # The payload-determined result, sans the per-run stamps
+            # (duration_ms, trace lease/span ids) the agent loop adds.
+            return {k: result[k] for k in ("ok", "echo")}
+
+        # Reference: one agent drains everything.
+        c_ref = Controller(sched=SchedConfig(policy="fair"),
+                           lease_ttl_sec=600.0)
+        ids = submit_all(c_ref)
+        self._drain_with_threads(
+            c_ref, [self._fleet_agent(c_ref, "solo")]
+        )
+        want = {j: payload_part(c_ref.job_snapshot(j)["result"])
+                for j in ids}
+
+        c = Controller(sched=SchedConfig(policy="fair"),
+                       lease_ttl_sec=600.0)
+        ids = submit_all(c)
+        agents = [
+            self._fleet_agent(c, "fleet-a"),
+            self._fleet_agent(c, "fleet-b"),
+        ]
+        self._drain_with_threads(c, agents)
+        got = {j: payload_part(c.job_snapshot(j)["result"]) for j in ids}
+        assert got == want  # bit-identical, wherever each shard ran
+        executed_by = {c.job_snapshot(j)["agent"] for j in ids}
+        assert executed_by == {"fleet-a", "fleet-b"}, (
+            f"shards did not spread across the fleet: {executed_by}"
+        )
+
+    def test_idle_member_preferred_over_backed_up_member(self):
+        """The queue_depth-aware placement that spreads a fleet: a deep-
+        staged member is deferred on bulk shards while an idle one takes
+        them immediately (patience keeps it from starving)."""
+        c = fair_controller(placement_patience=2, busy_queue_depth=2)
+        for i in range(4):
+            c.submit("echo", {"x": i}, job_id=f"shard-{i}-idlepref")
+        busy_caps = {"ops": ["echo"], "queue_depth": 9}
+        idle_caps = {"ops": ["echo"], "queue_depth": 0}
+        assert c.lease("busy", busy_caps) is None  # deferred, not granted
+        lease = c.lease("idle", idle_caps, max_tasks=4)
+        assert lease is not None and len(lease["tasks"]) == 4
+
+    def test_concurrent_agents_with_unequal_depth_both_finish(self):
+        """Liveness under preference: even a permanently 'busy'-advertising
+        member keeps working (patience bound), and the drain completes with
+        every shard exactly once."""
+        c = Controller(sched=SchedConfig(
+            policy="fair", placement_patience=1, busy_queue_depth=2,
+        ), lease_ttl_sec=600.0)
+        ids = [
+            c.submit("echo", {"x": i}, job_id=f"shard-{i}-unequal")
+            for i in range(self.N_SHARDS)
+        ]
+        agents = [
+            self._fleet_agent(c, "deep", depth_fn=lambda: 9),
+            self._fleet_agent(c, "idle", depth_fn=lambda: 0),
+        ]
+        self._drain_with_threads(c, agents)
+        by_agent: dict = {}
+        for j in ids:
+            snap = c.job_snapshot(j)
+            assert snap["state"] == "succeeded"
+            assert snap["attempts"] == 1  # exactly once, no re-leases
+            by_agent[snap["agent"]] = by_agent.get(snap["agent"], 0) + 1
+        # The idle-advertising member must carry work; the deep one may
+        # still win deferred shards once patience expires.
+        assert by_agent.get("idle", 0) > 0, by_agent
+
+
+# ---------------------------------------------------------------------------
 # Admission control: budgets → 429 + retry_after_ms, transient class.
 # ---------------------------------------------------------------------------
 
