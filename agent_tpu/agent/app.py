@@ -44,8 +44,9 @@ from typing import Any, Dict, List, Optional, Tuple
 from agent_tpu.agent.spool import ResultSpool
 from agent_tpu.config import Config
 from agent_tpu.data import wire
+from agent_tpu.obs.health import RollingWindow, resolve_peak_flops
 from agent_tpu.obs.metrics import MetricsRegistry
-from agent_tpu.obs.recorder import FlightRecorder
+from agent_tpu.obs.recorder import FlightRecorder, default_dump_path
 from agent_tpu.obs.trace import (
     SpanBuffer,
     TraceContext,
@@ -76,6 +77,11 @@ PHASE_KEYS = (
 )
 
 STATUS_TRANSPORT_ERROR = 0  # "could not reach the controller at all"
+
+# Rolling duty-cycle window (ISSUE 8): 60s matches the "is the device busy
+# RIGHT NOW" question the autoscaler asks; the cumulative busy/idle
+# counters remain the long-horizon view.
+DUTY_WINDOW_SEC = 60.0
 
 
 def collect_host_metrics() -> Dict[str, Any]:
@@ -153,9 +159,28 @@ class Agent:
         self.m_device_idle = self.obs.counter(
             "device_idle_seconds_total",
             "Device-thread seconds blocked waiting for staged work")
+        # Per-op device attribution (ISSUE 8): busy seconds carry the op so
+        # /v1/health can say WHICH workload owns the device, not just that
+        # it is busy. Fleet-merge/scrape consumers that sum the family are
+        # unaffected (labels sum); value() readers must now pass op=.
         self.m_device_busy = self.obs.counter(
             "device_busy_seconds_total",
-            "Device-thread seconds dispatching op execute phases")
+            "Device-thread seconds dispatching op execute phases, per op",
+            ("op",))
+        self.m_duty = self.obs.gauge(
+            "device_duty_cycle",
+            "Rolling duty cycle: device-busy seconds inside the last "
+            f"{int(DUTY_WINDOW_SEC)}s window / window span")
+        self.m_flops = self.obs.counter(
+            "device_flops_total",
+            "Analytic model FLOPs dispatched, per op and shape bucket "
+            "(matmul terms only — the ops' own estimate)",
+            ("op", "shape"))
+        self.m_mfu = self.obs.gauge(
+            "device_mfu",
+            "Model FLOPs utilization per op: analytic FLOPs / device-busy "
+            "seconds / peak dense-bf16 FLOP/s (absent when the peak is "
+            "unknown — PEAK_TFLOPS overrides)", ("op",))
         self.m_post_fail = self.obs.counter(
             "result_post_failures_total",
             "Result posts that failed (then spooled, or dropped if the "
@@ -214,6 +239,18 @@ class Agent:
         # Poster-thread session override (PipelineRunner._post_loop):
         # callable returning a session; None = a fresh requests.Session.
         self.post_session_factory: Optional[Any] = None
+        # Fleet health (ISSUE 8): rolling duty window + cumulative per-op
+        # busy/FLOPs for the MFU gauge. Touched only by the device-dispatch
+        # thread (serial loop or the pipeline's execute loop).
+        self._duty = RollingWindow(DUTY_WINDOW_SEC)
+        self._busy_by_op: Dict[str, float] = {}
+        self._flops_by_op: Dict[str, float] = {}
+        self._peak_flops: Optional[float] = None
+        # SLO page alerts piggybacked on granted leases: objectives whose
+        # page episode this agent already dumped its ring for (one dump per
+        # episode; clearing re-arms).
+        self._page_dumped: set = set()
+        self.slo_dump_paths: List[str] = []
 
     # ---- controller I/O ----
 
@@ -289,8 +326,81 @@ class Agent:
                 pass
         return caps
 
+    def note_device_time(
+        self, op: str, seconds: float, tags: Optional[Dict[str, Any]] = None
+    ) -> None:
+        """Per-op device attribution (ISSUE 8), called by the dispatch loop
+        after every op execute: busy counter (op-labeled), rolling duty
+        cycle, and — when the op stamped its analytic FLOPs into
+        ``ctx.tags["device_attr"]`` — the FLOPs counter per shape bucket
+        and the ``device_mfu{op}`` gauge (FLOPs / busy / peak)."""
+        if seconds < 0:
+            seconds = 0.0
+        self.m_device_busy.inc(seconds, op=op)
+        self._duty.add(seconds)
+        self.m_duty.set(round(self._duty.fraction(), 4))
+        self._busy_by_op[op] = self._busy_by_op.get(op, 0.0) + seconds
+        attr = (tags or {}).get("device_attr")
+        if isinstance(attr, dict):
+            flops = attr.get("flops")
+            if isinstance(flops, (int, float)) and flops > 0:
+                self.m_flops.inc(
+                    float(flops), op=op, shape=str(attr.get("shape", "?"))
+                )
+                self._flops_by_op[op] = (
+                    self._flops_by_op.get(op, 0.0) + float(flops)
+                )
+        if self._peak_flops is None:
+            self._peak_flops = resolve_peak_flops(self.runtime)
+        busy = self._busy_by_op.get(op, 0.0)
+        flops_total = self._flops_by_op.get(op, 0.0)
+        if self._peak_flops and busy > 0 and flops_total > 0:
+            self.m_mfu.set(
+                round(flops_total / busy / self._peak_flops, 6), op=op
+            )
+
+    def note_alerts(self, alerts: Any) -> None:
+        """React to SLO page alerts piggybacked on a granted lease (ISSUE 8
+        satellite): entering ``page`` dumps THIS agent's flight-recorder
+        ring, tagged with the breaching objective's ``{tier, op}`` — the
+        agent half of the evidence pair (the controller dumps its own ring
+        at the transition). One dump per objective per page episode; an
+        objective that recovers re-arms."""
+        active: set = set()
+        for a in alerts or []:
+            if not isinstance(a, dict) or a.get("state") != "page":
+                continue
+            objective = a.get("objective")
+            if not objective:
+                continue
+            active.add(objective)
+            if objective in self._page_dumped:
+                continue
+            self._page_dumped.add(objective)
+            bits = "-".join(
+                f"{k}{a[k]}" for k in ("tier", "tenant", "op") if a.get(k)
+            ) or "all"
+            path = default_dump_path(
+                f"agent-{self.config.agent.agent_name}-slo-{objective}-{bits}"
+            )
+            self.recorder.record(
+                "slo_page", objective=objective, path=path,
+                **{k: a[k] for k in ("tier", "tenant", "op") if a.get(k)},
+            )
+            try:
+                n = self.recorder.dump(path)
+                self.slo_dump_paths.append(path)
+                log("slo page — agent flight recorder dumped",
+                    objective=objective, path=path, events=n)
+            except OSError:
+                pass  # a failing dump must not stop the drain
+        self._page_dumped &= active
+
     def _metrics(self) -> Dict[str, Any]:
         m = collect_host_metrics()
+        # Duty decays while idle: refresh at snapshot time so a quiet agent
+        # reads 0, not its last busy moment.
+        self.m_duty.set(round(self._duty.fraction(), 4))
         if self.runtime is not None:
             try:
                 m["device"] = self.runtime.describe()
@@ -483,6 +593,9 @@ class Agent:
         # the controller changed its mind (e.g. restarted without binary).
         fmt = body.get("wire")
         self.wire_format = fmt if fmt in wire.FORMATS else None
+        # SLO page alerts ride granted leases (absent in steady state);
+        # entering page auto-dumps this agent's flight recorder.
+        self.note_alerts(body.get("alerts"))
         self.m_lease.inc(outcome="tasks")
         self.recorder.record(
             "lease", lease_id=lease_id, n_tasks=len(tasks),
@@ -812,6 +925,10 @@ class Agent:
                 start_mono=t_exec0, duration_s=t_done - t_exec0,
                 op=op, status=status,
             )
+            # Serial-loop device attribution (ISSUE 8): the monolithic call
+            # IS the dispatch window here (the pipelined loop measures its
+            # own). Previously only the pipeline recorded busy seconds.
+            self.note_device_time(op, t_done - t_exec0, ctx.tags)
         duration_ms = (t_done - t0) * 1000.0
         if isinstance(result, dict):
             result.setdefault("duration_ms", duration_ms)

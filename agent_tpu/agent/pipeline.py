@@ -349,7 +349,11 @@ class PipelineRunner:
                         type=type(exc).__name__, message=str(exc)[:200],
                     )
                 dt = time.perf_counter() - t_exec
-                agent.m_device_busy.inc(dt)
+                # Per-op device attribution + duty/MFU rollup (ISSUE 8).
+                agent.note_device_time(
+                    item.op, dt,
+                    item.ctx.tags if item.ctx is not None else None,
+                )
                 agent.m_phase.observe(
                     dt, exemplar={"trace_id": item.job_id},
                     op=item.op, phase="execute",

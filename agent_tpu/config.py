@@ -337,6 +337,53 @@ class SchedConfig:
 
 
 @dataclass(frozen=True)
+class SloConfig:
+    """Fleet health / SLO engine knobs (ISSUE 8 — the SLO_* env surface).
+
+    ``enabled=False`` (``SLO_ENABLED=0``) no-ops the whole judgment path:
+    no tracker is built, ``observe`` never runs, and ``GET /v1/health``
+    reports ``slo.enabled: false`` while still serving the fleet/queue
+    signals. ``spec`` is the declarative objective list
+    (``SLO_SPEC='[{"tier":8,"p99_ms":250,"availability":0.999}]'``; empty
+    = the built-in interactive-tier default, see ``obs/slo.py``).
+    """
+
+    enabled: bool = True                  # SLO_ENABLED
+    spec: str = ""                        # SLO_SPEC (JSON; "" = default)
+    # Google-SRE multi-window burn-rate alerting: the short window catches
+    # fast burns, the long window stops one bad minute from paging.
+    window_short_sec: float = 300.0       # SLO_WINDOW_SHORT_SEC
+    window_long_sec: float = 3600.0       # SLO_WINDOW_LONG_SEC
+    burn_warn: float = 3.0                # SLO_BURN_WARN (enter `warn`)
+    burn_page: float = 10.0               # SLO_BURN_PAGE (enter `page`)
+    # Hysteresis: a level exits only once the short-window burn falls below
+    # enter_threshold * this fraction — oscillation around the line holds.
+    burn_exit_frac: float = 0.5           # SLO_BURN_EXIT_FRAC
+    # Agents silent longer than this count stale in the /v1/health verdict.
+    agent_stale_sec: float = 60.0         # HEALTH_AGENT_STALE_SEC
+
+    @staticmethod
+    def from_env() -> "SloConfig":
+        short = max(0.1, env_float("SLO_WINDOW_SHORT_SEC", 300.0))
+        return SloConfig(
+            enabled=env_bool("SLO_ENABLED", True),
+            spec=env_str("SLO_SPEC", ""),
+            window_short_sec=short,
+            window_long_sec=max(
+                short, env_float("SLO_WINDOW_LONG_SEC", 3600.0)
+            ),
+            burn_warn=max(0.0, env_float("SLO_BURN_WARN", 3.0)),
+            burn_page=max(0.0, env_float("SLO_BURN_PAGE", 10.0)),
+            burn_exit_frac=min(
+                1.0, max(0.0, env_float("SLO_BURN_EXIT_FRAC", 0.5))
+            ),
+            agent_stale_sec=max(
+                1.0, env_float("HEALTH_AGENT_STALE_SEC", 60.0)
+            ),
+        )
+
+
+@dataclass(frozen=True)
 class OpsConfig:
     """Per-op knobs (reference ``ops/map_summarize.py:9-10``, trigger envs)."""
 

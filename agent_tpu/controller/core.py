@@ -61,15 +61,17 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
-from agent_tpu.config import TRUTHY_TOKENS, SchedConfig
+from agent_tpu.config import TRUTHY_TOKENS, SchedConfig, SloConfig
 from agent_tpu.data import wire
+from agent_tpu.obs.health import build_health
 from agent_tpu.obs.metrics import (
     MetricsRegistry,
     histogram_quantile,
     merge_snapshots,
     render_snapshots,
 )
-from agent_tpu.obs.recorder import FlightRecorder
+from agent_tpu.obs.recorder import FlightRecorder, default_dump_path
+from agent_tpu.obs.slo import SloTracker, parse_slo_spec
 from agent_tpu.obs.trace import TraceStore
 from agent_tpu.obs import trace as obs_trace
 from agent_tpu.sched import (
@@ -198,6 +200,7 @@ class Controller:
         sched: Optional[SchedConfig] = None,
         trace_store: Optional[TraceStore] = None,
         wire_binary: bool = True,
+        slo: Optional[SloConfig] = None,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
         # Binary shard wire (ISSUE 6): False = never negotiate (a JSON-only
@@ -304,6 +307,30 @@ class Controller:
         self._m_http_bytes = m.counter(
             "controller_http_bytes_total",
             "HTTP bytes on the data-plane routes", ("route", "direction"))
+        # Fleet health / SLO engine (ISSUE 8): declarative objectives fed by
+        # submit→apply latencies at result-apply time, judged by multi-window
+        # burn rates, rolled into GET /v1/health. SLO_ENABLED=0 leaves
+        # self.slo None and no-ops the whole path (observe/evaluate/alerts).
+        self.slo_config = slo if slo is not None else SloConfig()
+        self.slo: Optional[SloTracker] = None
+        # Page-entry auto-dump bookkeeping: dump paths written this process
+        # (tests and the CI smoke assert on them), one dump per objective
+        # per page episode.
+        self.slo_dump_paths: List[str] = []
+        if self.slo_config.enabled:
+            # A malformed SLO_SPEC fails controller boot — an objective
+            # typo silently judging nothing is the rot this refuses.
+            self.slo = SloTracker(
+                parse_slo_spec(self.slo_config.spec),
+                registry=self.metrics,
+                clock=self._clock,
+                window_short_sec=self.slo_config.window_short_sec,
+                window_long_sec=self.slo_config.window_long_sec,
+                burn_warn=self.slo_config.burn_warn,
+                burn_page=self.slo_config.burn_page,
+                burn_exit_frac=self.slo_config.burn_exit_frac,
+                on_alert=self._on_slo_alert,
+            )
         # The policy object every lease decision delegates to (ISSUE 4).
         self._sched = make_scheduler(
             self.sched_config, on_decision=self._on_sched_decision
@@ -365,6 +392,112 @@ class Controller:
                 "defers": job.placement_defers,
             },
         })
+
+    # ---- fleet health / SLO engine (ISSUE 8) ----
+
+    def _on_slo_alert(
+        self, result: Dict[str, Any], old: str, new: str
+    ) -> None:
+        """Burn-rate alert transition hook (fires outside the controller
+        lock — evaluate runs before/after lock-held sections). Entering
+        ``page`` auto-dumps the controller flight-recorder ring, tagged
+        with the breaching objective's ``{tier, op}`` — the post-hoc
+        evidence that previously only existed for SIGUSR1/fatal paths."""
+        selector = {
+            k: result.get(k) for k in ("tier", "tenant", "op")
+            if result.get(k) is not None
+        }
+        self.recorder.record(
+            "slo_alert", objective=result.get("objective"),
+            old_state=old, new_state=new,
+            burn_short=result.get("burn_rate_short"),
+            burn_long=result.get("burn_rate_long"), **selector,
+        )
+        log(
+            "slo alert transition", objective=result.get("objective"),
+            old=old, new=new, burn_short=result.get("burn_rate_short"),
+            burn_long=result.get("burn_rate_long"),
+        )
+        if new != "page":
+            return
+        tag_bits = "-".join(
+            f"{k}{v}" for k, v in selector.items()
+        ) or "all"
+        path = default_dump_path(
+            f"controller-slo-{result.get('objective')}-{tag_bits}"
+        )
+        try:
+            n = self.recorder.dump(path)
+            self.slo_dump_paths.append(path)
+            log("slo page — flight recorder dumped", path=path, events=n)
+        except OSError:
+            pass  # a failing dump must not take down the control plane
+
+    def _slo_observe_locked(self, job: Job, now: float) -> None:
+        """Feed one terminal job into the SLO tracker: submit→apply latency
+        on the controller clock, success = SUCCEEDED. The tracker has its
+        own lock and does a handful of integer bumps — cheap enough to run
+        under the controller lock at drain scale."""
+        if self.slo is None:
+            return
+        self.slo.observe(
+            max(0.0, now - job.submitted_at),
+            ok=job.state == SUCCEEDED,
+            tier=job.priority,
+            tenant=job.tenant,
+            op=job.op,
+            now=now,
+        )
+
+    def starvation_age_sec(self) -> Optional[float]:
+        """Age (since submit) of the oldest currently-queued job — the live
+        starvation signal /v1/health reports (the existing
+        ``sched_starvation_age_seconds`` histogram only records at first
+        lease, so a job that never leases is invisible to it)."""
+        with self._lock:
+            now = self._clock()
+            ages = [
+                now - self._jobs[jid].submitted_at
+                for jid in self._sched.queued_ids()
+                if jid in self._jobs
+            ]
+        return max(ages) if ages else None
+
+    def health_json(self) -> Dict[str, Any]:
+        """The ``GET /v1/health`` body: SLO attainment/burn states, queue
+        pressure (per-tier depth + starvation age), per-agent duty
+        cycle/MFU/liveness, and one rolled-up verdict — the signal vector
+        ROADMAP item 4's autoscaler consumes."""
+        slo_results = self.slo.evaluate() if self.slo is not None else []
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for job in self._jobs.values():
+                counts[job.state] = counts.get(job.state, 0) + 1
+            queue_depth = self._sched.total()
+            by_tier = self._sched.depth_by_priority()
+            now = self._clock()
+            ages = [
+                now - self._jobs[jid].submitted_at
+                for jid in self._sched.queued_ids()
+                if jid in self._jobs
+            ]
+            agents = {
+                a: {
+                    "last_seen_wall": e.get("last_seen_wall", 0.0),
+                    "obs": e.get("obs"),
+                }
+                for a, e in self.agent_metrics.items()
+            }
+        return build_health(
+            slo_enabled=self.slo is not None,
+            slo_objectives=slo_results,
+            counts=counts,
+            queue_depth=queue_depth,
+            queue_by_tier=by_tier,
+            starvation_age_sec=max(ages) if ages else None,
+            agents=agents,
+            agent_stale_sec=self.slo_config.agent_stale_sec,
+        )
 
     @property
     def _queue(self) -> List[str]:
@@ -526,6 +659,12 @@ class Controller:
             # elapsing): the sweep is what keeps the split gauge truthful
             # with no lease traffic.
             self._update_queue_stats_locked()
+        if self.slo is not None:
+            # Burn states must decay without traffic too (recovery after the
+            # last slow request is itself a window rollover) — the sweeper
+            # is the no-traffic evaluation cadence. Outside the lock: the
+            # alert hook does file I/O on page entry.
+            self.slo.evaluate()
 
     def start_sweeper(self, interval_sec: float = 5.0) -> None:
         """TTL enforcement without traffic: a daemon thread sweeping every
@@ -914,6 +1053,9 @@ class Controller:
                     job.job_id, job.root_span_id, now,
                     attributes={"outcome": DEAD, "reason": "DeadlineExceeded"},
                 )
+                # A deadline death is an availability breach the SLO engine
+                # must see — it never passes through report().
+                self._slo_observe_locked(job, now)
                 self._m_dead.inc(op=job.op)
                 self._m_deadline_dead.inc(op=job.op)
                 self._m_sched_decisions.inc(
@@ -1016,6 +1158,15 @@ class Controller:
         caps = capabilities or {}
         ops = set(caps.get("ops") or [])
         labels = labels or {}
+        # SLO alert piggyback (ISSUE 8 satellite): keep the judgment fresh
+        # (rate-limited to ~1/s inside the tracker) and collect any paging
+        # objectives BEFORE taking the controller lock — the page-entry hook
+        # dumps the flight recorder (file I/O). Granted leases carry the
+        # active page alerts so agents can auto-dump their own rings.
+        page_alerts: List[Dict[str, Any]] = []
+        if self.slo is not None:
+            self.slo.maybe_evaluate()
+            page_alerts = self.slo.active_alerts("page")
         # Binary-wire negotiation (ISSUE 6): both sides must opt in — the
         # agent by advertising, this controller by configuration. Old
         # agents never advertise, so they keep byte-identical JSON.
@@ -1208,6 +1359,11 @@ class Controller:
                 return None
             self._m_lease.inc(outcome="granted")
             out = {"lease_id": lease_id, "tasks": tasks}
+            if page_alerts:
+                # Only when something is paging: the wire stays byte-
+                # identical to the pre-health protocol otherwise, and old
+                # agents ignore the extra key either way.
+                out["alerts"] = page_alerts
             if wire_fmt:
                 # The negotiation answer: the agent may now binary-encode
                 # its result columns. Stamped on every negotiated grant so
@@ -1361,6 +1517,10 @@ class Controller:
                     job.job_id, job.root_span_id, now,
                     attributes={"outcome": job.state},
                 )
+                # SLO feed (ISSUE 8): one observation per job, at terminal
+                # apply — the submit→apply span, the latency a submitter
+                # actually experienced (retries included).
+                self._slo_observe_locked(job, now)
             else:
                 # Transient-failure requeue: the next sched.decide span
                 # measures its wait from here.

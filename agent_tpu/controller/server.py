@@ -240,6 +240,14 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
             return
+        if path == "/v1/health":
+            # Fleet health verdict (ISSUE 8): per-tier SLO attainment +
+            # burn-rate alert states, per-agent duty cycle/MFU/liveness,
+            # queue pressure, one rolled-up ok|warn|page verdict — the
+            # machine-readable signal vector the autoscaler (ROADMAP item
+            # 4) and scripts/swarmtop.py consume.
+            self._send(200, self.controller.health_json())
+            return
         if self.path == "/v1/status":
             self._send(
                 200,
@@ -332,6 +340,7 @@ def main() -> int:
 
     from agent_tpu.config import (
         SchedConfig,
+        SloConfig,
         env_bool,
         env_float,
         env_int,
@@ -354,6 +363,9 @@ def main() -> int:
         # WIRE_BINARY=0 runs a JSON-only controller (binary-capable agents
         # simply never get the `wire` answer and stay on JSON).
         wire_binary=env_bool("WIRE_BINARY", True),
+        # SLO_* / HEALTH_* knobs (ISSUE 8): declarative objectives, burn
+        # thresholds, windows; SLO_ENABLED=0 no-ops the judgment path.
+        slo=SloConfig.from_env(),
     )
     server = ControllerServer(controller, host=host, port=port)
     stop = threading.Event()

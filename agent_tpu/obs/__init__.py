@@ -22,11 +22,22 @@ from agent_tpu.obs.metrics import (
     render_snapshots,
     validate_exposition,
 )
+from agent_tpu.obs.health import (
+    RollingWindow,
+    build_health,
+    resolve_peak_flops,
+)
 from agent_tpu.obs.recorder import (
     FlightRecorder,
     default_dump_path,
     get_recorder,
     install_sigusr1_dump,
+)
+from agent_tpu.obs.slo import (
+    DEFAULT_SLO_SPEC,
+    Objective,
+    SloTracker,
+    parse_slo_spec,
 )
 from agent_tpu.obs.trace import (
     Span,
@@ -38,6 +49,13 @@ from agent_tpu.obs.trace import (
 )
 
 __all__ = [
+    "DEFAULT_SLO_SPEC",
+    "Objective",
+    "RollingWindow",
+    "SloTracker",
+    "build_health",
+    "parse_slo_spec",
+    "resolve_peak_flops",
     "Span",
     "SpanBuffer",
     "TraceContext",

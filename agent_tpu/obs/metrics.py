@@ -483,7 +483,20 @@ def histogram_quantile(
     """Estimate the q-quantile (0..1) from per-bucket counts (+Inf slot
     last), linearly interpolating within the landing bucket — the same
     estimate Prometheus's ``histogram_quantile`` makes. None when empty.
-    Values in the +Inf slot clamp to the largest finite bound."""
+    Values in the +Inf slot clamp to the largest finite bound.
+
+    **Pinned error bound** (ISSUE 8 satellite, property-tested in
+    ``tests/test_obs.py::TestQuantileErrorBound``): for observations within
+    the finite bucket range, the estimate lands in the same bucket as the
+    exact sample quantile, so the absolute error is **at most one bucket
+    width** (the width of the bucket containing the true quantile). This
+    holds for FLEET-MERGED snapshots too: ``merge_snapshots`` sums
+    per-bucket counts losslessly (every agent shares the fixed
+    ``DEFAULT_BUCKETS``), so a merged estimate is exactly the estimate the
+    pooled samples would have produced — merging adds NO error beyond the
+    single-histogram bound. Observations beyond the largest finite bound
+    land in +Inf and clamp to that bound, where the error is unbounded by
+    construction; size the top bucket above the latencies you must judge."""
     total = sum(counts)
     if total <= 0:
         return None

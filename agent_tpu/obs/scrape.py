@@ -59,6 +59,17 @@ def op_phase_seconds(
     return out
 
 
+def fetch_health(
+    base_url: str, timeout: float = 10.0
+) -> Optional[Dict[str, Any]]:
+    """``GET /v1/health`` → the fleet verdict body (ISSUE 8), or None on
+    any failure. Callers that promised health reporting (bench,
+    drain_at_scale) must fail loudly on None instead of omitting the
+    fields silently."""
+    out = fetch_json(base_url, "/v1/health", timeout=timeout)
+    return out if isinstance(out, dict) else None
+
+
 # ---- trace endpoints (ISSUE 5) ----
 
 def fetch_json(

@@ -15,6 +15,66 @@ from dataclasses import fields
 from typing import Any, Dict, Iterator, List, Sequence, Tuple
 
 
+# ---- analytic FLOPs (ISSUE 8: the MFU numerator) ----
+#
+# Matmul terms only (2·M·N·K per matmul; elementwise/softmax are noise at
+# model scale) — the same accounting bench.py's encoder_flops_per_row has
+# always used, now stamped per executed shard so the agent can export a
+# live device_mfu{op} gauge. These are ESTIMATES by design: the point is a
+# stable utilization trend per shape bucket, not a profiler.
+
+def encoder_fwd_flops(
+    batch: int, seq_len: int, d_model: int, d_ff: int, n_layers: int,
+    n_classes: int = 0,
+) -> float:
+    """Forward FLOPs of ``batch`` rows through an encoder stack at padded
+    length ``seq_len``: QKVO projections + score/value matmuls + FFN per
+    layer, plus the classifier head."""
+    d, f, L = float(d_model), float(d_ff), float(seq_len)
+    attn_proj = 8.0 * L * d * d          # 4 projections × 2·L·d·d
+    attn_sdpa = 4.0 * L * L * d          # QKᵀ and P·V × 2·L²·d
+    ffn = 4.0 * L * d * f                # 2 matmuls × 2·L·d·f
+    per_row = n_layers * (attn_proj + attn_sdpa + ffn) + 2.0 * d * n_classes
+    return batch * per_row
+
+
+def seq2seq_fwd_flops(
+    batch: int, src_len: int, new_tokens: int, d_model: int, d_ff: int,
+    n_enc_layers: int, n_dec_layers: int, vocab_size: int = 0,
+    num_beams: int = 1,
+) -> float:
+    """Forward FLOPs of an encode + incremental greedy/beam decode:
+    the encoder stack over ``src_len``, then per generated token a
+    single-position decoder step (self-attn + cross-attn projections, FFN,
+    cross-attention reads over the cached ``src_len`` keys, vocab
+    projection). Beams multiply the decode rows in flight."""
+    d, f = float(d_model), float(d_ff)
+    enc = encoder_fwd_flops(batch, src_len, d_model, d_ff, n_enc_layers)
+    rows = float(batch * max(1, num_beams))
+    per_tok_layer = (
+        8.0 * d * d          # self-attn QKVO projections (one position)
+        + 8.0 * d * d        # cross-attn QKVO projections
+        + 4.0 * src_len * d  # cross-attn scores + values over the cache
+        + 4.0 * d * f        # FFN
+    )
+    dec = rows * new_tokens * (
+        n_dec_layers * per_tok_layer + 2.0 * d * vocab_size
+    )
+    return enc + dec
+
+
+def stamp_device_flops(ctx, flops: float, shape: str) -> None:
+    """Accumulate an op's analytic-FLOPs estimate (and its dominant shape
+    bucket) into ``ctx.tags["device_attr"]`` — the channel the agent's
+    dispatch loop reads to feed ``device_flops_total{op,shape}`` and the
+    ``device_mfu{op}`` gauge. No-op without a ctx (pure-op callers)."""
+    if ctx is None or not hasattr(ctx, "tags") or flops <= 0:
+        return
+    attr = ctx.tags.setdefault("device_attr", {})
+    attr["flops"] = attr.get("flops", 0.0) + float(flops)
+    attr["shape"] = str(shape)
+
+
 def resolve_model_id(payload: Dict[str, Any], env_var: str, default: str) -> str:
     """payload ``model_path`` → env var → default (ref ``_tpu_runtime.py:23-31``)."""
     mp = payload.get("model_path")

@@ -553,6 +553,42 @@ def stage(payload: Any, ctx: Optional[object] = None):
     return "staged", state
 
 
+def _stamp_flops(state: Dict[str, Any], ctx: Optional[object]) -> None:
+    """Analytic-FLOPs attribution (ISSUE 8): estimate the dispatched matmul
+    FLOPs from the staged chunk shapes and the model config, stamped into
+    ``ctx.tags["device_attr"]`` so the agent can export ``device_mfu{op}``.
+    Dimension names differ per family (encoder: d_model/d_ff/n_layers,
+    BERT: hidden_size/intermediate_size/num_layers); a config missing them
+    simply doesn't stamp — MFU is then absent, never wrong."""
+    cfg = state.get("cfg")
+    d = getattr(cfg, "d_model", None) or getattr(cfg, "hidden_size", None)
+    f = getattr(cfg, "d_ff", None) or getattr(cfg, "intermediate_size", None)
+    n_layers = (
+        getattr(cfg, "n_layers", None) or getattr(cfg, "num_layers", None)
+    )
+    if not (d and f and n_layers):
+        return
+    from agent_tpu.ops._model_common import (
+        encoder_fwd_flops,
+        stamp_device_flops,
+    )
+
+    total = 0.0
+    biggest = (0, "?")
+    for chunk in state.get("chunks") or []:
+        try:
+            B, L = chunk[0].shape
+        except Exception:  # noqa: BLE001 — estimation must never fail a shard
+            continue
+        total += encoder_fwd_flops(
+            B, L, d, f, n_layers, getattr(cfg, "n_classes", 0) or 0
+        )
+        if B * L > biggest[0]:
+            biggest = (B * L, f"B{B}xL{L}")
+    if total > 0:
+        stamp_device_flops(ctx, total, biggest[1])
+
+
 def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, Any]:
     """Device phase (owning thread only): run staged chunks on the mesh,
     falling back to the CPU backend per the degraded-mode contract."""
@@ -560,6 +596,7 @@ def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, An
     # the bounded queue between phases, and that wait must not count as
     # device time (it shows up as queue_ms instead).
     state["t_exec0"] = time.perf_counter()
+    _stamp_flops(state, ctx)
     model_id, cfg, k = state["model_id"], state["cfg"], state["k"]
     fallback_reason = None
     try:

@@ -425,9 +425,45 @@ def stage(payload: Any, ctx: Optional[object] = None):
     return "staged", state
 
 
+def _stamp_flops(state: Dict[str, Any], ctx: Optional[object]) -> None:
+    """Analytic-FLOPs attribution (ISSUE 8): encode + incremental decode
+    estimate from the staged chunk shapes, stamped into
+    ``ctx.tags["device_attr"]`` for the agent's ``device_mfu{op}`` gauge.
+    Configs missing the dimensions (exotic checkpoints) don't stamp."""
+    cfg = state.get("cfg")
+    d = getattr(cfg, "d_model", None)
+    f = getattr(cfg, "d_ff", None)
+    n_enc = getattr(cfg, "n_enc_layers", None)
+    n_dec = getattr(cfg, "n_dec_layers", None)
+    if not (d and f and n_enc and n_dec):
+        return
+    from agent_tpu.ops._model_common import (
+        seq2seq_fwd_flops,
+        stamp_device_flops,
+    )
+
+    total = 0.0
+    biggest = (0, "?")
+    for chunk in state.get("chunks") or []:
+        try:
+            B, L = chunk[0].shape
+        except Exception:  # noqa: BLE001 — estimation must never fail a shard
+            continue
+        total += seq2seq_fwd_flops(
+            B, L, state["max_new"], d, f, n_enc, n_dec,
+            vocab_size=getattr(cfg, "vocab_size", 0) or 0,
+            num_beams=state["num_beams"],
+        )
+        if B * L > biggest[0]:
+            biggest = (B * L, f"B{B}xL{L}xT{state['max_new']}")
+    if total > 0:
+        stamp_device_flops(ctx, total, biggest[1])
+
+
 def execute(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, Any]:
     """Device phase (owning thread only): compiled decode of staged chunks."""
     state["t_exec0"] = time.perf_counter()
+    _stamp_flops(state, ctx)
     if state["force_cpu"]:
         from agent_tpu.ops.map_classify_tpu import _get_cpu_runtime
 

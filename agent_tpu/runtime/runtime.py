@@ -339,6 +339,15 @@ class TpuRuntime:
         """
         return jax.device_put(arr, self.data_sharding())
 
+    def peak_flops(self) -> Optional[float]:
+        """Peak dense-bf16 FLOP/s of one device (MFU denominator, ISSUE 8):
+        the ``PEAK_TFLOPS`` env override first, else the public spec-sheet
+        table keyed by device_kind; None when unknown — MFU is then simply
+        not exported, never guessed."""
+        from agent_tpu.obs.health import resolve_peak_flops
+
+        return resolve_peak_flops(self)
+
     def describe(self) -> Dict[str, Any]:
         """Telemetry snapshot for the lease metrics channel (SURVEY.md §5.5)."""
         out: Dict[str, Any] = {

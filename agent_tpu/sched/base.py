@@ -123,6 +123,14 @@ class Scheduler:
     def depth_by_tenant(self) -> Dict[str, int]:
         return dict(self._depth_by_tenant)
 
+    def depth_by_priority(self) -> Dict[int, int]:
+        """Queued jobs per priority tier — the per-tier queue-pressure feed
+        ``GET /v1/health`` reports next to SLO attainment (ISSUE 8).
+        Subclasses with a cheaper view override; the default derives it
+        from ``queued_ids`` via the policy's own job references and is only
+        called off the hot path (health endpoint, swarmtop)."""
+        return {}
+
     # -- the policy surface (subclasses implement) --
 
     def add(self, job: Any) -> None:

@@ -145,6 +145,15 @@ class FairScheduler(Scheduler):
             self._rotation.pop(prio, None)
             self._deficit.pop(prio, None)
 
+    def depth_by_priority(self) -> Dict[int, int]:
+        # Health-endpoint feed (ISSUE 8): the tier structure already holds
+        # the split, so this is O(tiers × tenants), not O(jobs).
+        return {
+            prio: sum(len(q) for q in tier.values())
+            for prio, tier in self._tiers.items()
+            if tier
+        }
+
     # ---- placement ----
 
     def score(self, job: Any, ctx: LeaseContext) -> float:

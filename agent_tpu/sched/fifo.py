@@ -60,3 +60,12 @@ class FifoScheduler(Scheduler):
 
     def queued_ids(self) -> List[str]:
         return [job.job_id for job in self._order]
+
+    def depth_by_priority(self) -> dict:
+        # Health-endpoint feed (ISSUE 8): O(queue), called off the hot path.
+        # FIFO ignores priority for ORDER but the pressure split is still
+        # the signal the autoscaler wants.
+        out: dict = {}
+        for job in self._order:
+            out[job.priority] = out.get(job.priority, 0) + 1
+        return out

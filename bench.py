@@ -889,7 +889,17 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
             for i in range(n_rows):
                 f.write(f'{i},"drain record {i} with a payload of text",{i % 89}\n')
 
-        controller = Controller(lease_ttl_sec=600.0)
+        from agent_tpu.config import SloConfig
+
+        # SLO-judged drain (ISSUE 8): op-keyed objectives with a generous
+        # p99 (bulk shards legitimately run seconds) so the health leg
+        # records attainment/verdict without paging a healthy bench.
+        controller = Controller(lease_ttl_sec=600.0, slo=SloConfig(spec=(
+            '[{"name": "classify", "op": "map_classify_tpu",'
+            ' "p99_ms": 600000, "availability": 0.999},'
+            ' {"name": "summarize", "op": "map_summarize",'
+            ' "p99_ms": 600000, "availability": 0.999}]'
+        )))
         with ControllerServer(controller) as server:
             cfg = Config(
                 agent=AgentConfig(
@@ -997,8 +1007,31 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
                 )
                 trace_line = phase_breakdown(worst)
                 print(f"[slowest shard] {trace_line}", flush=True)
+            # Fleet health rollup (ISSUE 8 satellite): the verdict and the
+            # per-op attainment/MFU ride the artifact as flat fields; an
+            # unreachable /v1/health FAILS the leg instead of silently
+            # omitting them.
+            from agent_tpu.obs.scrape import fetch_health
+
+            health = fetch_health(server.url)
+            assert health is not None, (
+                "health path broken: GET /v1/health unreachable for a "
+                "drained leg"
+            )
+            print(f"[health] verdict={health['verdict']}", flush=True)
+            slo_attain = {
+                o.get("op", o["objective"]): o.get("attainment")
+                for o in health["slo"]["objectives"]
+            }
+            mfu_by_op: dict = {}
+            for row in (health.get("agents") or {}).values():
+                for op, v in (row.get("mfu") or {}).items():
+                    mfu_by_op[op] = v
             total_rows = n_rows + DRAIN_SUMMARIZE_ROWS
             mixed_leg = {
+                "health_verdict": health["verdict"],
+                "slo_attainment": slo_attain,
+                "mfu": mfu_by_op or None,
                 "rows_per_sec": round(total_rows / wall, 1),
                 "classify_rows": n_rows,
                 "summarize_rows": DRAIN_SUMMARIZE_ROWS,
@@ -1491,6 +1524,23 @@ def main() -> int:
                 "multichip_scaling_efficiency": legs["drain_multichip"]
                 .get("scaling_efficiency"),
                 "multichip_n_chips": legs["drain_multichip"].get("n_chips"),
+                # Fleet health flat fields (ISSUE 8): verdict + per-op SLO
+                # attainment and live MFU off GET /v1/health for the mixed
+                # drain leg.
+                "health_verdict": legs.get("drain_mixed", {})
+                .get("health_verdict"),
+                "slo_attainment_classify": (
+                    legs.get("drain_mixed", {}).get("slo_attainment") or {}
+                ).get("map_classify_tpu"),
+                "slo_attainment_summarize": (
+                    legs.get("drain_mixed", {}).get("slo_attainment") or {}
+                ).get("map_summarize"),
+                "mfu_classify": (
+                    legs.get("drain_mixed", {}).get("mfu") or {}
+                ).get("map_classify_tpu"),
+                "mfu_summarize": (
+                    legs.get("drain_mixed", {}).get("mfu") or {}
+                ).get("map_summarize"),
             }
         ),
         flush=True,

@@ -36,6 +36,15 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 CLASSIFY_SHARD = 8192
+# SLO objectives for the drain (ISSUE 8): op-keyed, generous p99 (bulk
+# shards legitimately run seconds) — the point is recording attainment and
+# the verdict in the artifact, not paging a healthy drain.
+SLO_SPEC = (
+    '[{"name": "classify", "op": "map_classify_tpu",'
+    ' "p99_ms": 600000, "availability": 0.999},'
+    ' {"name": "summarize", "op": "map_summarize",'
+    ' "p99_ms": 600000, "availability": 0.999}]'
+)
 # Summarize throughput scales with decode rows in flight (measured on v5e,
 # payload-size sweep: 4,980 → 8,093 rows/s from 1k → 8k rows, dispatched
 # as chained ≤MAX_DECODE_ROWS programs; one single B=8192 program measured
@@ -98,6 +107,48 @@ def per_agent_shards(controller, job_ids):
     return counts
 
 
+def health_report(server_url):
+    """Flat per-op SLO attainment / MFU + the verdict off ``GET
+    /v1/health`` (ISSUE 8 satellite). None when unreachable — callers FAIL
+    the drain on that (the fields were promised, silence is rot)."""
+    from agent_tpu.obs.scrape import fetch_health
+
+    health = fetch_health(server_url)
+    if health is None:
+        return None
+    attain = {
+        o.get("op", o["objective"]): o.get("attainment")
+        for o in health["slo"]["objectives"]
+    }
+    mfu: dict = {}
+    duty: dict = {}
+    for name, row in (health.get("agents") or {}).items():
+        duty[name] = row.get("duty_cycle")
+        for op, v in (row.get("mfu") or {}).items():
+            mfu.setdefault(op, []).append(v)
+    return {
+        "verdict": health["verdict"],
+        "attain": attain,
+        # Fleet MFU per op = mean across reporting agents.
+        "mfu": {
+            op: round(sum(vs) / len(vs), 4) for op, vs in mfu.items()
+        },
+        "duty": duty,
+    }
+
+
+def health_fields(hf):
+    """The flat report fields both drain modes record."""
+    return {
+        "health_verdict": hf["verdict"],
+        "slo_attainment_classify": hf["attain"].get("map_classify_tpu"),
+        "slo_attainment_summarize": hf["attain"].get("map_summarize"),
+        "mfu_classify": hf["mfu"].get("map_classify_tpu"),
+        "mfu_summarize": hf["mfu"].get("map_summarize"),
+        "duty_cycle_by_agent": hf["duty"],
+    }
+
+
 def overlap_report(server_url):
     """(fleet overlap, per-agent overlap) from the trace window; either may
     be None when tracing is off — callers decide how loud to be."""
@@ -155,8 +206,12 @@ def main() -> int:
     summarize_out = os.path.join(args.workdir, "summarize_out")
     build_csv(csv_path, args.rows)
 
+    from agent_tpu.config import SloConfig
+
     runtime = get_runtime()
-    controller = Controller(lease_ttl_sec=600.0)
+    controller = Controller(
+        lease_ttl_sec=600.0, slo=SloConfig(spec=SLO_SPEC)
+    )
     t_start = time.perf_counter()
     with ControllerServer(controller) as server:
         cfg = Config(
@@ -386,8 +441,18 @@ def main() -> int:
             controller,
             [j for j in controller.results() if j not in warm_jobs],
         )
+        # Fleet health rollup (ISSUE 8 satellite): verdict + flat per-op
+        # attainment/MFU in the artifact; an unreachable /v1/health FAILS
+        # the drain instead of silently omitting the promised fields.
+        hf = health_report(server.url)
+        if hf is None:
+            print("DRAIN FAILED: GET /v1/health unreachable", flush=True)
+            return 1
+        print(f"[health] verdict={hf['verdict']} "
+              f"attainment={hf['attain']} mfu={hf['mfu']}", flush=True)
 
     report = {
+        **health_fields(hf),
         "rows": args.rows,
         "ops": ["map_classify_tpu", "map_summarize"],
         "wall_s": round(wall, 1),
@@ -512,8 +577,11 @@ def main_fleet(args) -> int:
             os.path.join(args.workdir, "warm_out"),
         ), f)
 
+    from agent_tpu.config import SloConfig
+
     controller = Controller(
-        lease_ttl_sec=600.0, sched=SchedConfig(policy="fair")
+        lease_ttl_sec=600.0, sched=SchedConfig(policy="fair"),
+        slo=SloConfig(spec=SLO_SPEC),
     )
     drain_ops = ("map_classify_tpu", "map_summarize")
     with ControllerServer(controller) as server:
@@ -627,10 +695,20 @@ def main_fleet(args) -> int:
                         f"(stage p50 {o['stage_p50_ms']:.1f} ms, execute "
                         f"p50 {o['execute_p50_ms']:.1f} ms)", flush=True,
                     )
+            # Fleet health rollup (ISSUE 8): same contract as the single
+            # leg — the promised fields or a loud failure.
+            hf = health_report(server.url)
+            if hf is None:
+                print("DRAIN FAILED: GET /v1/health unreachable",
+                      flush=True)
+                return 1
+            print(f"[health] verdict={hf['verdict']} "
+                  f"attainment={hf['attain']} mfu={hf['mfu']}", flush=True)
         finally:
             handle.stop()
 
     report = {
+        **health_fields(hf),
         "rows": args.rows,
         "ops": list(drain_ops),
         "mode": mode,

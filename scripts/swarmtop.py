@@ -1,0 +1,256 @@
+#!/usr/bin/env python3
+"""swarmtop — live terminal dashboard for an agent-tpu fleet (ISSUE 8).
+
+Renders fleet state from ``GET /v1/health`` + ``/v1/status`` +
+``/v1/metrics`` the way ``top`` renders a host: a verdict banner, per-SLO
+attainment/burn/budget rows, queue pressure by tier, and one row per agent
+(liveness, rolling duty cycle, per-op MFU, staged queue depth, task
+throughput from the scrape delta between frames).
+
+    python scripts/swarmtop.py --url http://controller:8080
+    python scripts/swarmtop.py --url ... --once        # one frame (CI/cron)
+    python scripts/swarmtop.py --url ... --interval 5  # refresh cadence
+
+Dependency-free by the obs charter: stdlib urllib + ANSI escapes only.
+``--once`` / ``--no-color`` make it pipeline-safe; exit code 2 when the
+controller is unreachable (so a watchdog cron can alert on it), else 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from agent_tpu.obs.metrics import parse_exposition  # noqa: E402
+
+RESET = "\x1b[0m"
+BOLD = "\x1b[1m"
+DIM = "\x1b[2m"
+FG = {"ok": "\x1b[32m", "warn": "\x1b[33m", "page": "\x1b[31m"}
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def fetch_json(url: str, timeout: float = 5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            if resp.status != 200:
+                return None
+            return json.loads(resp.read().decode("utf-8", errors="replace"))
+    except Exception:  # noqa: BLE001 — a down controller renders as such
+        return None
+
+
+def fetch_text(url: str, timeout: float = 5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            if resp.status != 200:
+                return None
+            return resp.read().decode("utf-8", errors="replace")
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def fmt_pct(v, digits: int = 1) -> str:
+    return f"{v * 100:.{digits}f}%" if isinstance(v, (int, float)) else "-"
+
+
+def fmt_num(v, digits: int = 2) -> str:
+    return f"{v:.{digits}f}" if isinstance(v, (int, float)) else "-"
+
+
+def bar(frac, width: int = 10) -> str:
+    """A tiny utilization bar: ``[####......]``."""
+    if not isinstance(frac, (int, float)):
+        return "[" + " " * width + "]"
+    n = max(0, min(width, int(round(frac * width))))
+    return "[" + "#" * n + "." * (width - n) + "]"
+
+
+class Colors:
+    def __init__(self, enabled: bool) -> None:
+        self.enabled = enabled
+
+    def paint(self, text: str, *codes: str) -> str:
+        if not self.enabled or not codes:
+            return text
+        return "".join(codes) + text + RESET
+
+    def state(self, state: str) -> str:
+        return self.paint(state.upper(), FG.get(state, ""), BOLD)
+
+
+def tasks_total(metrics_text) -> float:
+    """Fleet-wide completed tasks off the exposition (unlabeled merge only —
+    ``agent``-labeled duplicates would double-count)."""
+    if not metrics_text:
+        return 0.0
+    try:
+        samples = parse_exposition(metrics_text)
+    except ValueError:
+        return 0.0
+    return sum(
+        v for labels, v in samples.get("tasks_total", [])
+        if "agent" not in labels
+    )
+
+
+def render(health, status, rate, colors: Colors) -> str:
+    lines = []
+    verdict = health.get("verdict", "?")
+    now = time.strftime("%H:%M:%S")
+    reasons = health.get("reasons") or []
+    head = (
+        f"{colors.paint('swarmtop', BOLD)}  {now}   verdict: "
+        f"{colors.state(verdict)}"
+    )
+    if rate is not None:
+        head += f"   fleet: {rate:.1f} tasks/s"
+    lines.append(head)
+    for r in reasons:
+        lines.append(colors.paint(f"  ! {json.dumps(r)}", FG["warn"]))
+    lines.append("")
+
+    slo = health.get("slo", {})
+    lines.append(colors.paint(
+        f"SLO objectives ({'on' if slo.get('enabled') else 'OFF'})", BOLD))
+    objectives = slo.get("objectives") or []
+    if objectives:
+        lines.append(colors.paint(
+            f"  {'objective':<24}{'state':<7}{'attain':>8}{'burn 5m':>9}"
+            f"{'burn 1h':>9}{'budget':>8}{'p99 ms':>9}{'reqs':>7}", DIM))
+        for o in objectives:
+            short = (o.get("windows") or {}).get("short") or {}
+            state = str(o.get("state", "?"))
+            # Pad on the PLAIN text, colorize after — ANSI codes have
+            # nonzero len() and would wreck the column math.
+            state_cell = colors.paint(
+                state.upper(), FG.get(state, ""), BOLD
+            ) + " " * max(0, 7 - len(state))
+            lines.append(
+                f"  {str(o.get('objective'))[:23]:<24}"
+                f"{state_cell}"
+                f"{fmt_pct(o.get('attainment'), 2):>8}"
+                f"{fmt_num(o.get('burn_rate_short')):>9}"
+                f"{fmt_num(o.get('burn_rate_long')):>9}"
+                f"{fmt_pct(o.get('error_budget_remaining'), 0):>8}"
+                f"{fmt_num(short.get('p99_ms'), 1):>9}"
+                f"{short.get('requests', 0):>7}"
+            )
+    else:
+        lines.append(colors.paint("  (no objectives configured)", DIM))
+    lines.append("")
+
+    q = health.get("queue", {})
+    tiers = ", ".join(
+        f"t{k}:{v}" for k, v in sorted(
+            (q.get("by_tier") or {}).items(), key=lambda kv: -int(kv[0])
+        )
+    ) or "-"
+    starv = q.get("starvation_age_sec")
+    lines.append(
+        f"{colors.paint('Queue', BOLD)}  depth {q.get('depth', 0)}"
+        f"  by tier: {tiers}"
+        f"  oldest wait: {fmt_num(starv, 1)}s"
+    )
+    counts = health.get("counts") or {}
+    if counts:
+        lines.append(colors.paint(
+            "  jobs: " + " ".join(
+                f"{k}={v}" for k, v in sorted(counts.items())
+            ), DIM))
+    lines.append("")
+
+    fleet = health.get("fleet", {})
+    lines.append(colors.paint(
+        f"Agents ({fleet.get('n_agents', 0)} seen, "
+        f"{fleet.get('n_stale', 0)} stale)", BOLD))
+    agents = health.get("agents") or {}
+    if agents:
+        lines.append(colors.paint(
+            f"  {'agent':<20}{'seen':>7}{'duty':>18}{'mfu':>16}"
+            f"{'staged':>8}{'busy s':>9}", DIM))
+        for name, row in agents.items():
+            mfu = row.get("mfu") or {}
+            mfu_s = ",".join(
+                f"{op.replace('map_', '')[:8]}:{fmt_pct(v, 1)}"
+                for op, v in sorted(mfu.items())
+            ) or "-"
+            duty = row.get("duty_cycle")
+            seen = f"{row.get('last_seen_sec_ago', 0):.0f}s"
+            line = (
+                f"  {name[:19]:<20}{seen:>7}"
+                f"{bar(duty):>12} {fmt_pct(duty, 0):>5}"
+                f"{mfu_s:>16}"
+                f"{fmt_num(row.get('queue_depth'), 0):>8}"
+                f"{fmt_num(row.get('device_busy_s'), 1):>9}"
+            )
+            if row.get("stale"):
+                line = colors.paint(line, FG["warn"])
+            lines.append(line)
+    else:
+        lines.append(colors.paint("  (no agent has leased yet)", DIM))
+
+    summary = (status or {}).get("summary") or {}
+    phases = summary.get("task_phase_seconds") or {}
+    if phases:
+        lines.append("")
+        lines.append(colors.paint("Phase p99 (ms, fleet)", BOLD))
+        for op, per in sorted(phases.items()):
+            cells = "  ".join(
+                f"{ph}:{(st.get('p99') or 0) * 1e3:.1f}"
+                for ph, st in sorted(per.items())
+            )
+            lines.append(f"  {op:<20} {cells}")
+    return "\n".join(lines) + "\n"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=os.environ.get(
+        "CONTROLLER_URL", "http://127.0.0.1:8080"))
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (CI / cron)")
+    ap.add_argument("--no-color", action="store_true")
+    args = ap.parse_args()
+    base = args.url.rstrip("/")
+    colors = Colors(
+        enabled=not args.no_color
+        and (sys.stdout.isatty() or os.environ.get("FORCE_COLOR"))
+    )
+
+    prev_tasks = None
+    prev_t = None
+    while True:
+        health = fetch_json(base + "/v1/health")
+        if health is None:
+            print(f"swarmtop: controller unreachable at {base}",
+                  file=sys.stderr)
+            if args.once:
+                return 2
+            time.sleep(args.interval)
+            continue
+        status = fetch_json(base + "/v1/status")
+        total = tasks_total(fetch_text(base + "/v1/metrics"))
+        now = time.monotonic()
+        rate = None
+        if prev_tasks is not None and now > prev_t:
+            rate = max(0.0, (total - prev_tasks) / (now - prev_t))
+        prev_tasks, prev_t = total, now
+        frame = render(health, status, rate, colors)
+        if args.once:
+            sys.stdout.write(frame)
+            return 0
+        sys.stdout.write((CLEAR if colors.enabled else "") + frame)
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -206,6 +206,72 @@ class TestScrapeHelpers:
 
         assert op_phase_seconds("not prometheus {{{", ("x",)) == {"x": 0.0}
 
+    def test_overlap_from_spans_zero_span_trace(self):
+        """ISSUE 8 satellite: a trace window with NO closed stage/execute
+        spans (zero-span traces, spans missing durations) must return None,
+        not divide by zero or fabricate an overlap."""
+        from agent_tpu.obs.scrape import overlap_from_spans
+
+        assert overlap_from_spans([]) is None
+        # open spans (no duration) and non-dict garbage are skipped
+        assert overlap_from_spans([
+            {"name": "stage", "process": "agent:a", "start_wall": 1.0,
+             "duration_ms": None},
+            {"name": "execute", "process": "agent:a", "start_wall": 1.0},
+            "not-a-span", None, 42,
+        ]) is None
+        # stage spans but no execute (a drain that died pre-dispatch)
+        assert overlap_from_spans([
+            {"name": "stage", "process": "agent:a", "start_wall": 1.0,
+             "duration_ms": 5.0},
+        ]) is None
+
+    def test_overlap_by_process_single_agent(self):
+        """ISSUE 8 satellite: one-agent grouping — the per-process split
+        must yield exactly that agent's overlap (identical to the ungrouped
+        computation), with zero-span groups absent, not {} entries."""
+        from agent_tpu.obs.scrape import overlap_by_process, overlap_from_spans
+
+        spans = [
+            {"name": "execute", "process": "agent:solo", "start_wall": 0.0,
+             "duration_ms": 1000.0},
+            {"name": "stage", "process": "agent:solo", "start_wall": 0.5,
+             "duration_ms": 250.0},
+            # controller spans never carry stage/execute and are skipped
+            {"name": "apply", "process": "controller", "start_wall": 0.0,
+             "duration_ms": 1.0},
+            # an agent with only open spans contributes no group
+            {"name": "stage", "process": "agent:ghost", "start_wall": 0.0,
+             "duration_ms": None},
+        ]
+        out = overlap_by_process(spans)
+        assert set(out) == {"solo"}
+        assert out["solo"] == overlap_from_spans(spans[:2])
+        assert out["solo"]["overlap_ratio"] == 1.0
+
+    def test_scrape_controller_with_no_agent_snapshots(self):
+        """ISSUE 8 satellite: a controller nothing has leased from yet must
+        still serve a valid exposition, and the scrape helpers must return
+        empty/zero results — not raise — against it."""
+        from agent_tpu.obs.scrape import op_phase_seconds
+
+        c = Controller()
+        c.submit("echo", {"x": 1})  # queued, never leased
+        with ControllerServer(c) as server:
+            with urllib.request.urlopen(server.url + "/v1/metrics") as r:
+                text = r.read().decode()
+            assert validate_exposition(text) == []
+            spans = op_phase_seconds(
+                text, ("map_classify_tpu", "map_summarize")
+            )
+            assert spans == {"map_classify_tpu": 0.0, "map_summarize": 0.0}
+            # health still answers with an ok verdict and an empty fleet
+            with urllib.request.urlopen(server.url + "/v1/health") as r:
+                health = json.load(r)
+        assert health["verdict"] == "ok"
+        assert health["fleet"] == {"n_agents": 0, "n_stale": 0}
+        assert health["queue"]["depth"] == 1
+
     def test_overlap_by_process_groups_agents(self):
         """ISSUE 7: per-agent overlap attribution — each agent's stage
         spans measured against ITS OWN execute spans, controller spans
@@ -231,6 +297,96 @@ class TestScrapeHelpers:
         assert out["b"]["overlap_ratio"] == 0.0
         # An agent's stage must NOT count as hidden under another agent's
         # execute — that is the whole point of the per-process grouping.
+
+
+class TestQuantileErrorBound:
+    """ISSUE 8 satellite: the fleet-merged histogram quantile estimate has a
+    PINNED error bound — within one bucket width of the exact sample
+    quantile (documented on ``histogram_quantile``). Property-tested over
+    seeded random samples split across random per-agent snapshots, so the
+    bound covers the merge path (``merge_snapshots`` sums bucket counts
+    losslessly), not just one registry."""
+
+    @staticmethod
+    def _exact_quantile(values, q):
+        # The q-quantile as "smallest v with ≥ q·n samples ≤ v" — the
+        # ceil(q·n)-th order statistic (what the bucket walk targets).
+        import math
+
+        vs = sorted(values)
+        rank = max(1, math.ceil(q * len(vs)))
+        return vs[rank - 1]
+
+    @staticmethod
+    def _bucket_width(buckets, value):
+        lower = 0.0
+        for b in buckets:
+            if value <= b:
+                return b - lower
+            lower = b
+        raise AssertionError(f"{value} beyond the finite bucket range")
+
+    def test_merged_quantiles_within_one_bucket_width(self):
+        import random
+
+        from agent_tpu.obs.metrics import DEFAULT_BUCKETS
+
+        rng = random.Random(0x5105)
+        for _case in range(60):
+            n_agents = rng.randint(1, 5)
+            regs = [MetricsRegistry() for _ in range(n_agents)]
+            hists = [
+                r.histogram("merged_lat_seconds", "m", ("op",)) for r in regs
+            ]
+            values = []
+            for _ in range(rng.randint(1, 300)):
+                # Log-uniform over the finite range: every bucket decade
+                # gets traffic (uniform would pile into the top buckets).
+                import math
+
+                v = 10.0 ** rng.uniform(-2.3, math.log10(DEFAULT_BUCKETS[-1]))
+                v = min(v, DEFAULT_BUCKETS[-1])
+                values.append(v)
+                hists[rng.randrange(n_agents)].observe(v, op="x")
+            merged = merge_snapshots([r.snapshot() for r in regs])
+            fam = merged["merged_lat_seconds"]
+            (series,) = fam["series"]
+            assert sum(series["counts"]) == len(values)
+            for q in (0.5, 0.95, 0.99):
+                est = histogram_quantile(fam["buckets"], series["counts"], q)
+                exact = self._exact_quantile(values, q)
+                width = self._bucket_width(fam["buckets"], exact)
+                assert abs(est - exact) <= width + 1e-9, (
+                    f"q={q}: estimate {est} vs exact {exact} exceeds one "
+                    f"bucket width {width} (n={len(values)}, "
+                    f"agents={n_agents})"
+                )
+
+    def test_merge_is_lossless_vs_pooled_histogram(self):
+        """The merge itself adds NO error: summed per-agent bucket counts
+        equal the single-histogram counts over the pooled samples, so the
+        merged estimate is bit-identical to the pooled estimate."""
+        import random
+
+        rng = random.Random(7)
+        pooled = MetricsRegistry()
+        ph = pooled.histogram("lat", "l", ("op",))
+        regs = [MetricsRegistry() for _ in range(3)]
+        hs = [r.histogram("lat", "l", ("op",)) for r in regs]
+        for _ in range(500):
+            v = rng.expovariate(5.0)
+            ph.observe(v, op="x")
+            hs[rng.randrange(3)].observe(v, op="x")
+        merged = merge_snapshots([r.snapshot() for r in regs])
+        (m_series,) = merged["lat"]["series"]
+        (p_series,) = pooled.snapshot()["lat"]["series"]
+        assert m_series["counts"] == p_series["counts"]
+        for q in (0.5, 0.9, 0.99):
+            assert histogram_quantile(
+                merged["lat"]["buckets"], m_series["counts"], q
+            ) == histogram_quantile(
+                merged["lat"]["buckets"], p_series["counts"], q
+            )
 
 
 class TestFlightRecorder:

@@ -1,0 +1,535 @@
+"""Fleet health & SLO engine (ISSUE 8): spec parsing, sliding windows,
+burn-rate math, alert hysteresis, the controller feed (submit→apply
+latencies at result-apply time), ``/v1/health`` assembly, the lease-borne
+page alerts + flight-recorder auto-dumps, and the per-op device
+attribution primitives (rolling duty window, peak-FLOPs resolution)."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from agent_tpu.config import AgentConfig, Config, SchedConfig, SloConfig
+from agent_tpu.controller.core import Controller
+from agent_tpu.controller.server import ControllerServer
+from agent_tpu.obs.health import (
+    RollingWindow,
+    build_health,
+    resolve_peak_flops,
+)
+from agent_tpu.obs.metrics import MetricsRegistry
+from agent_tpu.obs.slo import (
+    DEFAULT_SLO_SPEC,
+    Objective,
+    SloTracker,
+    parse_slo_spec,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---- spec parsing ----
+
+class TestSpecParsing:
+    def test_default_spec_is_the_interactive_tier(self):
+        (obj,) = parse_slo_spec("")
+        assert obj.name == "interactive"
+        assert obj.tier == 8
+        assert obj.p99_ms == 1000
+        assert obj.availability == 0.999
+        assert parse_slo_spec(None)[0] == obj
+        assert parse_slo_spec(DEFAULT_SLO_SPEC)[0] == obj
+
+    def test_explicit_spec_round_trips(self):
+        objs = parse_slo_spec(
+            '[{"tier": 8, "p99_ms": 250, "availability": 0.999},'
+            ' {"op": "map_classify_tpu", "tenant": "acme", "p50_ms": 50}]'
+        )
+        assert [o.name for o in objs] == ["tier8", "tenantacme_opmap_classify_tpu"]
+        assert objs[0].latency_targets() == [("p99_ms", pytest.approx(0.01), 0.25)]
+        assert objs[1].tenant == "acme" and objs[1].op == "map_classify_tpu"
+
+    @pytest.mark.parametrize("bad", [
+        "not json",
+        "{}",                                       # not a list
+        '[{"tier": 8}]',                            # no targets
+        '[{"tier": "eight", "p99_ms": 10}]',        # tier not int
+        '[{"p99_ms": -5}]',                         # non-positive target
+        '[{"availability": 1.5, "p99_ms": 10}]',    # availability out of range
+        '[{"p99_ms": 10, "bogus_key": 1}]',         # unknown key
+        '[{"name": "a", "p99_ms": 1}, {"name": "a", "p99_ms": 2}]',  # dup
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_slo_spec(bad)
+
+    def test_matching_selectors(self):
+        o = Objective(name="x", tier=8, op="echo")
+        assert o.matches(8, "anyone", "echo")
+        assert not o.matches(7, "anyone", "echo")
+        assert not o.matches(8, "anyone", "other")
+        assert Objective(name="all", p99_ms=1).matches(0, "t", "op")
+
+
+# ---- tracker math and state machine ----
+
+def make_tracker(clock, registry=None, on_alert=None, **kw):
+    defaults = dict(
+        window_short_sec=10.0, window_long_sec=40.0,
+        burn_warn=2.0, burn_page=8.0, burn_exit_frac=0.5,
+    )
+    defaults.update(kw)
+    return SloTracker(
+        parse_slo_spec('[{"name": "o", "p99_ms": 100, "availability": 0.9}]'),
+        registry=registry, clock=clock, on_alert=on_alert, **defaults,
+    )
+
+
+class TestTrackerMath:
+    def test_burn_rate_is_bad_fraction_over_budget(self):
+        clock = FakeClock()
+        t = make_tracker(clock)
+        # 100 requests, 10 over the 100ms p99 target: slow_frac 0.1,
+        # budget 0.01 → burn 10. Availability clean → its burn 0.
+        for i in range(100):
+            t.observe(0.5 if i < 10 else 0.01, ok=True)
+        (r,) = t.evaluate()
+        short = r["windows"]["short"]
+        assert short["requests"] == 100
+        assert short["burn_rate"] == pytest.approx(10.0)
+        assert short["targets"]["p99_ms"]["attained"] == pytest.approx(0.9)
+        assert short["targets"]["availability"]["burn_rate"] == 0.0
+        assert r["attainment"] == pytest.approx(0.9)
+        # error budget: long window burn 10 → fully consumed
+        assert r["error_budget_remaining"] == 0.0
+
+    def test_availability_breaches_count_errors(self):
+        clock = FakeClock()
+        t = make_tracker(clock)
+        for i in range(50):
+            t.observe(0.01, ok=i >= 10)  # 10 failures, all fast
+        (r,) = t.evaluate()
+        av = r["windows"]["short"]["targets"]["availability"]
+        assert av["attained"] == pytest.approx(0.8)
+        # budget 0.1 → burn = 0.2 / 0.1 = 2
+        assert av["burn_rate"] == pytest.approx(2.0)
+
+    def test_short_window_ages_out_old_observations(self):
+        clock = FakeClock()
+        t = make_tracker(clock)
+        for _ in range(20):
+            t.observe(0.5, ok=True)  # all slow NOW
+        (r,) = t.evaluate()
+        assert r["windows"]["short"]["burn_rate"] == pytest.approx(100.0)
+        clock.advance(15.0)  # past the 10s short window
+        (r,) = t.evaluate()
+        assert r["windows"]["short"]["requests"] == 0
+        assert r["windows"]["short"]["burn_rate"] == 0.0
+        # ...but still inside the 40s long window
+        assert r["windows"]["long"]["requests"] == 20
+
+    def test_empty_tracker_reports_no_attainment(self):
+        (r,) = make_tracker(FakeClock()).evaluate()
+        assert r["attainment"] is None
+        assert r["state"] == "ok"
+        assert r["error_budget_remaining"] == 1.0
+
+    def test_quantile_estimates_ride_along(self):
+        clock = FakeClock()
+        t = make_tracker(clock)
+        for _ in range(100):
+            t.observe(0.03, ok=True)
+        (r,) = t.evaluate()
+        # 30ms lands in the (25ms, 50ms] bucket: estimate within it
+        assert 25.0 <= r["windows"]["short"]["p99_ms"] <= 50.0
+
+
+class TestAlertHysteresis:
+    def test_page_enters_holds_and_recovers(self):
+        clock = FakeClock()
+        transitions = []
+        t = make_tracker(
+            clock,
+            on_alert=lambda res, old, new: transitions.append((old, new)),
+        )
+        # Both windows burn at 100 → page.
+        for _ in range(20):
+            t.observe(0.5, ok=True)
+        (r,) = t.evaluate()
+        assert r["state"] == "page"
+        assert transitions == [("ok", "page")]
+        # Mixed traffic drops the short burn to ~8·exit_frac ± — still
+        # above the exit threshold (8·0.5 = 4): the page HOLDS.
+        # (13s, not 10s: window reads include whole cells, so aging out is
+        # accurate to one 2s cell width — the documented granularity.)
+        clock.advance(13.0)  # slow burst leaves the short window
+        for i in range(100):
+            t.observe(0.5 if i < 5 else 0.01, ok=True)  # burn 5 ∈ [4, 8)
+        (r,) = t.evaluate()
+        assert r["windows"]["short"]["burn_rate"] == pytest.approx(5.0)
+        assert r["state"] == "page", "hysteresis must hold above exit"
+        # Clean traffic in a fresh short window → burn < exit → recover.
+        clock.advance(13.0)
+        for _ in range(50):
+            t.observe(0.01, ok=True)
+        (r,) = t.evaluate()
+        assert r["windows"]["short"]["burn_rate"] < 4.0
+        assert r["state"] == "ok"
+        assert transitions == [("ok", "page"), ("page", "ok")]
+
+    def test_warn_requires_both_windows(self):
+        clock = FakeClock(10_000.0)
+        t = make_tracker(clock)
+        # Pre-fill the LONG window with lots of clean traffic, outside the
+        # short window.
+        for _ in range(1000):
+            t.observe(0.01, ok=True)
+        clock.advance(15.0)
+        # A short burst of pure slowness: short burn 100, long burn
+        # diluted 20/1020 / 0.01 ≈ 1.96 < warn → NO alert (the long
+        # window is the "is this real" guard).
+        for _ in range(20):
+            t.observe(0.5, ok=True)
+        (r,) = t.evaluate()
+        assert r["windows"]["short"]["burn_rate"] == pytest.approx(100.0)
+        assert r["windows"]["long"]["burn_rate"] < 2.0
+        assert r["state"] == "ok"
+
+    def test_gauges_and_transition_counter_export(self):
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        t = make_tracker(clock, registry=reg)
+        for _ in range(10):
+            t.observe(0.5, ok=False)
+        t.evaluate()
+        snap = reg.snapshot()
+        state = {
+            tuple(sorted(s["labels"].items())): s["value"]
+            for s in snap["slo_alert_state"]["series"]
+        }
+        assert state == {(("objective", "o"),): 2.0}  # page
+        burn = {
+            s["labels"]["window"]: s["value"]
+            for s in snap["slo_burn_rate"]["series"]
+        }
+        assert burn["short"] > 8.0 and burn["long"] > 8.0
+        trans = snap["slo_alert_transitions_total"]["series"]
+        assert [(s["labels"], s["value"]) for s in trans] == [
+            ({"objective": "o", "state": "page"}, 1.0)
+        ]
+
+    def test_maybe_evaluate_rate_limits(self):
+        clock = FakeClock()
+        t = make_tracker(clock)
+        first = t.maybe_evaluate()
+        t.observe(0.5, ok=False)
+        # Within the interval: the cached judgment comes back unchanged.
+        assert t.maybe_evaluate() is first
+        clock.advance(2.0)
+        assert t.maybe_evaluate() is not first
+
+
+# ---- controller integration ----
+
+def make_controller(clock, spec=None, **slo_kw):
+    slo = SloConfig(
+        spec=spec if spec is not None else (
+            '[{"name": "echo", "op": "echo", "p99_ms": 100, '
+            '"availability": 0.9}]'
+        ),
+        window_short_sec=10.0, window_long_sec=40.0,
+        burn_warn=2.0, burn_page=8.0, **slo_kw,
+    )
+    return Controller(clock=clock, slo=slo)
+
+
+def run_jobs(c, clock, n, latency_s, ok=True, op="echo", priority=None):
+    for _ in range(n):
+        jid = c.submit(op, {"x": 1}, priority=priority)
+        lease = c.lease("a1", {"ops": [op]})
+        assert lease is not None
+        clock.advance(latency_s)
+        c.report(
+            lease["lease_id"], jid, 0,
+            "succeeded" if ok else "failed",
+            result={"ok": True} if ok else None,
+            error=None if ok else {"type": "RuntimeError", "message": "x",
+                                   "trace": ""},
+        )
+
+
+class TestControllerIntegration:
+    def test_submit_to_apply_latency_feeds_the_tracker(self):
+        clock = FakeClock()
+        c = make_controller(clock)
+        run_jobs(c, clock, 9, 0.01)
+        run_jobs(c, clock, 1, 0.5)  # one slow job: slow_frac 0.1 → burn 10
+        (r,) = c.slo.evaluate()
+        short = r["windows"]["short"]
+        assert short["requests"] == 10
+        assert short["targets"]["p99_ms"]["burn_rate"] == pytest.approx(10.0)
+
+    def test_failed_jobs_burn_the_availability_budget(self):
+        clock = FakeClock()
+        c = make_controller(clock)
+        # max_attempts=1 → first failure is terminal (one observation).
+        for _ in range(4):
+            jid = c.submit("echo", {}, max_attempts=1)
+            lease = c.lease("a1", {"ops": ["echo"]})
+            clock.advance(0.01)
+            c.report(lease["lease_id"], jid, 0, "failed",
+                     error={"type": "RuntimeError", "message": "x",
+                            "trace": ""})
+        (r,) = c.slo.evaluate()
+        av = r["windows"]["short"]["targets"]["availability"]
+        assert av["attained"] == 0.0
+
+    def test_deadline_dead_jobs_count_as_breaches(self):
+        clock = FakeClock()
+        c = Controller(clock=clock, slo=SloConfig(
+            spec='[{"name": "echo", "op": "echo", "availability": 0.9}]',
+            window_short_sec=10.0, window_long_sec=40.0,
+        ), sched=SchedConfig(policy="fair"))
+        c.submit("echo", {}, deadline_sec=1.0)
+        clock.advance(5.0)
+        c.sweep()  # deadline expiry → dead → SLO observation (ok=False)
+        (r,) = c.slo.evaluate()
+        assert r["windows"]["short"]["targets"]["availability"]["attained"] \
+            == 0.0
+
+    def test_slo_disabled_no_ops_the_whole_path(self):
+        clock = FakeClock()
+        c = Controller(clock=clock, slo=SloConfig(enabled=False))
+        assert c.slo is None
+        jid = c.submit("echo", {})
+        lease = c.lease("a1", {"ops": ["echo"]})
+        out = c.report(lease["lease_id"], jid, 0, "succeeded", result={})
+        assert out == {"accepted": True}
+        health = c.health_json()
+        assert health["slo"] == {"enabled": False, "objectives": []}
+        assert health["verdict"] == "ok"
+        # no slo_* families ever registered
+        assert not any(k.startswith("slo_") for k in c.metrics.snapshot())
+
+    def test_malformed_spec_fails_controller_boot(self):
+        with pytest.raises(ValueError):
+            Controller(slo=SloConfig(spec="[{}]"))
+
+    def test_page_dumps_controller_ring_tagged(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FLIGHT_RECORDER_DIR", str(tmp_path))
+        clock = FakeClock()
+        c = make_controller(clock)
+        run_jobs(c, clock, 10, 0.5)  # all slow → burn 100 → page
+        c.sweep()  # evaluation cadence without lease traffic
+        assert c.slo.states() == {"echo": "page"}
+        assert len(c.slo_dump_paths) == 1
+        path = c.slo_dump_paths[0]
+        assert path.startswith(str(tmp_path))
+        assert "slo-echo" in path and "opecho" in path
+        events = [json.loads(line) for line in open(path)]
+        kinds = {e["kind"] for e in events}
+        # the dump carries the alert transition AND the drain history
+        assert "slo_alert" in kinds and "lease" in kinds
+        alert = next(e for e in events if e["kind"] == "slo_alert")
+        assert alert["op"] == "echo" and alert["new_state"] == "page"
+        # a second sweep must not dump again (one per episode)
+        clock.advance(0.5)
+        c.sweep()
+        assert len(c.slo_dump_paths) == 1
+
+    def test_lease_piggybacks_page_alerts_and_agent_dumps(
+        self, tmp_path, monkeypatch
+    ):
+        from agent_tpu.agent.app import Agent
+        from agent_tpu.chaos import LoopbackSession
+
+        monkeypatch.setenv("FLIGHT_RECORDER_DIR", str(tmp_path))
+        clock = FakeClock()
+        c = make_controller(clock)
+        run_jobs(c, clock, 10, 0.5)
+        clock.advance(1.1)  # past the maybe_evaluate rate limit
+        c.submit("echo", {"x": 2})
+        lease = c.lease("a2", {"ops": ["echo"]})
+        assert lease["alerts"] == [
+            {"objective": "echo", "state": "page", "op": "echo"}
+        ]
+        # The real agent path: lease_once sees the alerts and dumps its ring.
+        cfg = Config(agent=AgentConfig(
+            controller_url="http://loopback", agent_name="pagee",
+            tasks=("echo",), idle_sleep_sec=0.0,
+        ))
+        agent = Agent(config=cfg, session=LoopbackSession(c))
+        agent._profile = {"tier": "test"}
+        c.submit("echo", {"x": 3})
+        clock.advance(1.1)
+        assert agent.lease_once() is not None
+        assert len(agent.slo_dump_paths) == 1
+        assert "agent-pagee-slo-echo" in agent.slo_dump_paths[0]
+        events = [json.loads(line) for line in open(agent.slo_dump_paths[0])]
+        assert any(e["kind"] == "slo_page" for e in events)
+        # same episode → no second dump
+        c.submit("echo", {"x": 4})
+        clock.advance(1.1)
+        agent.lease_once()
+        assert len(agent.slo_dump_paths) == 1
+
+    def test_health_json_queue_and_starvation(self):
+        clock = FakeClock()
+        c = Controller(
+            clock=clock, sched=SchedConfig(policy="fair"),
+            slo=SloConfig(enabled=False),
+        )
+        c.submit("echo", {}, priority=8)
+        clock.advance(3.0)
+        c.submit("echo", {}, priority=2)
+        c.submit("echo", {}, priority=2)
+        h = c.health_json()
+        assert h["queue"]["depth"] == 3
+        assert h["queue"]["by_tier"] == {"2": 2, "8": 1}
+        assert h["queue"]["starvation_age_sec"] == pytest.approx(3.0)
+        assert h["counts"] == {"pending": 3}
+
+    def test_health_json_depth_by_tier_fifo(self):
+        c = Controller(slo=SloConfig(enabled=False))
+        c.submit("echo", {}, priority=8)
+        c.submit("echo", {}, priority=4)
+        assert c.health_json()["queue"]["by_tier"] == {"4": 1, "8": 1}
+
+    def test_stale_agents_flip_the_verdict_to_warn(self):
+        clock = FakeClock()
+        c = Controller(clock=clock, slo=SloConfig(
+            enabled=False, agent_stale_sec=5.0,
+        ))
+        c.lease("old-agent", {"ops": ["echo"]}, max_tasks=0,
+                metrics={"cpu_util": 0.1})
+        # make the last_seen wall timestamp old
+        c.agent_metrics["old-agent"]["last_seen_wall"] = time.time() - 60.0
+        c.submit("echo", {})  # queued work + a silent fleet = warn
+        h = c.health_json()
+        assert h["verdict"] == "warn"
+        assert h["agents"]["old-agent"]["stale"] is True
+        assert {r["kind"] for r in h["reasons"]} == {"no_live_agents"}
+        # no queued work → stale agents alone stay informational
+        c2 = Controller(slo=SloConfig(enabled=False, agent_stale_sec=5.0))
+        c2.lease("idle", {"ops": []}, max_tasks=0, metrics={"x": 1})
+        c2.agent_metrics["idle"]["last_seen_wall"] = time.time() - 60.0
+        assert c2.health_json()["verdict"] == "ok"
+
+    def test_health_over_http(self):
+        c = Controller()
+        with ControllerServer(c) as server:
+            with urllib.request.urlopen(server.url + "/v1/health") as r:
+                body = json.load(r)
+        assert body["verdict"] == "ok"
+        assert body["slo"]["enabled"] is True
+        assert body["slo"]["objectives"][0]["objective"] == "interactive"
+
+
+# ---- device-attribution primitives ----
+
+class TestRollingWindow:
+    def test_fraction_and_aging(self):
+        clock = FakeClock(100.0)
+        w = RollingWindow(window_sec=10.0, clock=clock)
+        clock.advance(20.0)  # tracker lifetime exceeds the window
+        w.add(5.0)
+        assert w.fraction() == pytest.approx(0.5)
+        clock.advance(20.0)  # busy span ages out
+        assert w.fraction() == 0.0
+
+    def test_young_tracker_clips_span_to_lifetime(self):
+        clock = FakeClock(100.0)
+        w = RollingWindow(window_sec=60.0, clock=clock)
+        clock.advance(2.0)
+        w.add(1.0)
+        # 1 busy second over a 2s lifetime, not over the whole minute
+        assert w.fraction() == pytest.approx(0.5)
+
+    def test_events_coalesce_per_second(self):
+        clock = FakeClock(50.0)
+        w = RollingWindow(window_sec=30.0, clock=clock)
+        for _ in range(1000):
+            w.add(0.001)
+        assert len(w._events) == 1
+        assert w.total() == pytest.approx(1.0)
+
+
+class TestPeakFlops:
+    def test_env_override_wins(self, monkeypatch):
+        monkeypatch.setenv("PEAK_TFLOPS", "2.5")
+        assert resolve_peak_flops(None) == pytest.approx(2.5e12)
+
+    def test_unknown_device_returns_none(self, monkeypatch):
+        monkeypatch.delenv("PEAK_TFLOPS", raising=False)
+        assert resolve_peak_flops(None) is None
+
+        class FakeDev:
+            device_kind = "Quantum Abacus"
+
+        class FakeRt:
+            devices = [FakeDev()]
+
+        assert resolve_peak_flops(FakeRt()) is None
+
+        class V5e:
+            device_kind = "TPU v5e"
+
+        class Rt5:
+            devices = [V5e()]
+
+        assert resolve_peak_flops(Rt5()) == pytest.approx(197e12)
+
+
+class TestBuildHealthPure:
+    def test_page_objective_pages_the_verdict(self):
+        h = build_health(
+            slo_enabled=True,
+            slo_objectives=[{
+                "objective": "o", "state": "page",
+                "burn_rate_short": 50.0, "burn_rate_long": 20.0,
+            }],
+        )
+        assert h["verdict"] == "page"
+        assert h["reasons"][0]["kind"] == "slo_burn"
+
+    def test_agent_rows_prefer_rolling_duty_gauge(self):
+        reg = MetricsRegistry()
+        reg.counter(
+            "device_busy_seconds_total", "b", ("op",)
+        ).inc(30.0, op="x")
+        reg.counter("device_idle_seconds_total", "i").inc(70.0)
+        reg.gauge("device_duty_cycle", "d").set(0.85)
+        reg.gauge("device_mfu", "m", ("op",)).set(0.44, op="x")
+        h = build_health(
+            slo_enabled=False,
+            agents={"a": {"last_seen_wall": time.time(),
+                          "obs": reg.snapshot()}},
+        )
+        row = h["agents"]["a"]
+        assert row["duty_cycle"] == 0.85  # the gauge, not 0.3
+        assert row["mfu"] == {"x": 0.44}
+        assert row["device_busy_s_by_op"] == {"x": 30.0}
+        assert row["stale"] is False
+
+    def test_legacy_unlabeled_busy_counter_degrades(self):
+        reg = MetricsRegistry()
+        reg.counter("device_busy_seconds_total", "b").inc(25.0)
+        reg.counter("device_idle_seconds_total", "i").inc(75.0)
+        h = build_health(
+            slo_enabled=False,
+            agents={"a": {"last_seen_wall": time.time(),
+                          "obs": reg.snapshot()}},
+        )
+        assert h["agents"]["a"]["duty_cycle"] == pytest.approx(0.25)
