@@ -36,6 +36,7 @@ redelivery idempotent.
 
 from __future__ import annotations
 
+import os
 import signal
 import sys
 import time
@@ -46,7 +47,9 @@ from agent_tpu.config import Config
 from agent_tpu.data import wire
 from agent_tpu.obs.health import RollingWindow, resolve_peak_flops
 from agent_tpu.obs.metrics import MetricsRegistry
+from agent_tpu.obs.profile import device_memory_stats
 from agent_tpu.obs.recorder import FlightRecorder, default_dump_path
+from agent_tpu.obs.usage import stamp_usage
 from agent_tpu.obs.trace import (
     SpanBuffer,
     TraceContext,
@@ -181,6 +184,11 @@ class Agent:
             "Model FLOPs utilization per op: analytic FLOPs / device-busy "
             "seconds / peak dense-bf16 FLOP/s (absent when the peak is "
             "unknown — PEAK_TFLOPS overrides)", ("op",))
+        self.m_hbm = self.obs.gauge(
+            "device_hbm_bytes",
+            "Per-device accelerator memory from memory_stats(), across ALL "
+            "local devices (absent on backends that report none — CPU)",
+            ("device", "kind"))
         self.m_post_fail = self.obs.counter(
             "result_post_failures_total",
             "Result posts that failed (then spooled, or dropped if the "
@@ -251,6 +259,18 @@ class Agent:
         # episode; clearing re-arms).
         self._page_dumped: set = set()
         self.slo_dump_paths: List[str] = []
+        # On-demand deep captures (ISSUE 9): requests arrive as
+        # `profile_capture` lease alerts, wrap the next matching op
+        # execution in jax.profiler.trace, and the completion records ship
+        # back on the lease metrics channel. Touched only by the dispatch
+        # thread (captures) and the lease loop (completions).
+        self._pending_captures: List[Dict[str, Any]] = []
+        self._captures_seen: set = set()
+        self._capture_done: List[Dict[str, Any]] = []
+        # Mesh width for chip-seconds attribution: device_s × chips is what
+        # the ledger turns into chip-seconds (a dp=8 dispatch second spans
+        # 8 chips). Cached on first use; 1 without a runtime.
+        self._usage_chips: Optional[float] = None
 
     # ---- controller I/O ----
 
@@ -340,16 +360,33 @@ class Agent:
         self._duty.add(seconds)
         self.m_duty.set(round(self._duty.fraction(), 4))
         self._busy_by_op[op] = self._busy_by_op.get(op, 0.0) + seconds
+        task_flops = 0.0
         attr = (tags or {}).get("device_attr")
         if isinstance(attr, dict):
             flops = attr.get("flops")
             if isinstance(flops, (int, float)) and flops > 0:
+                task_flops = float(flops)
                 self.m_flops.inc(
                     float(flops), op=op, shape=str(attr.get("shape", "?"))
                 )
                 self._flops_by_op[op] = (
                     self._flops_by_op.get(op, 0.0) + float(flops)
                 )
+        # Per-task usage stamp (ISSUE 9): the SAME seconds that feed the
+        # busy counter ride the result body, so the controller's showback
+        # ledger reconciles with device_busy_seconds_total exactly.
+        if self._usage_chips is None:
+            try:
+                self._usage_chips = (
+                    float(self.runtime.n_devices)
+                    if self.runtime is not None else 1.0
+                )
+            except Exception:  # noqa: BLE001 — telemetry must never raise
+                self._usage_chips = 1.0
+        stamp_usage(
+            tags, device_s=seconds, chips=self._usage_chips,
+            flops=task_flops or None,
+        )
         if self._peak_flops is None:
             self._peak_flops = resolve_peak_flops(self.runtime)
         busy = self._busy_by_op.get(op, 0.0)
@@ -368,7 +405,23 @@ class Agent:
         objective that recovers re-arms."""
         active: set = set()
         for a in alerts or []:
-            if not isinstance(a, dict) or a.get("state") != "page":
+            if not isinstance(a, dict):
+                continue
+            if a.get("kind") == "profile_capture":
+                # On-demand deep capture (ISSUE 9): arm one jax.profiler
+                # trace around the next matching op execution. Deduped by
+                # capture id — the alerts channel may redeliver.
+                cid = a.get("capture_id")
+                if isinstance(cid, str) and cid \
+                        and cid not in self._captures_seen:
+                    self._captures_seen.add(cid)
+                    self._pending_captures.append({
+                        "capture_id": cid,
+                        "op": a.get("op"),
+                        "duration_ms": a.get("duration_ms"),
+                    })
+                continue
+            if a.get("state") != "page":
                 continue
             objective = a.get("objective")
             if not objective:
@@ -396,11 +449,29 @@ class Agent:
                 pass  # a failing dump must not stop the drain
         self._page_dumped &= active
 
+    def _refresh_hbm_gauges(self) -> None:
+        """``device_hbm_bytes{device,kind}`` from ``memory_stats()`` across
+        ALL local devices (ISSUE 9) — refreshed at snapshot time like the
+        duty gauge. Backends without stats (CPU) export nothing: the family
+        is cleanly absent, never zero-filled."""
+        if self.runtime is None:
+            return
+        try:
+            for entry in device_memory_stats(self.runtime.devices):
+                for kind in ("used", "limit", "peak"):
+                    if kind in entry:
+                        self.m_hbm.set(
+                            entry[kind], device=entry["device"], kind=kind
+                        )
+        except Exception:  # noqa: BLE001 — telemetry must never kill a lease
+            pass
+
     def _metrics(self) -> Dict[str, Any]:
         m = collect_host_metrics()
         # Duty decays while idle: refresh at snapshot time so a quiet agent
         # reads 0, not its last busy moment.
         self.m_duty.set(round(self._duty.fraction(), 4))
+        self._refresh_hbm_gauges()
         if self.runtime is not None:
             try:
                 m["device"] = self.runtime.describe()
@@ -420,6 +491,7 @@ class Agent:
         result posts so the final counters reach the fleet view; best-effort
         by contract."""
         spans: List[Dict[str, Any]] = []
+        captures: List[Dict[str, Any]] = []
         try:
             a = self.config.agent
             metrics = self._metrics()
@@ -429,6 +501,9 @@ class Agent:
                 # post/redeliver) postdate the last result post, so the
                 # flush lease is what completes the last jobs' trees.
                 metrics["spans"] = spans
+            captures = self._drain_capture_results()
+            if captures:
+                metrics["profile_captures"] = captures
             status, _ = self._post_json(
                 "/v1/leases",
                 {
@@ -447,12 +522,15 @@ class Agent:
                 },
                 session=session,
             )
-            if status not in (200, 204) and spans:
-                self.tracer.requeue(spans)
+            if status not in (200, 204):
+                if spans:
+                    self.tracer.requeue(spans)
+                self._requeue_capture_results(captures)
             return status in (200, 204)
         except Exception:  # noqa: BLE001 — flush must never fail a drain
             if spans:
                 self.tracer.requeue(spans)
+            self._requeue_capture_results(captures)
             return False
 
     def record_phase_timings(
@@ -551,6 +629,10 @@ class Agent:
             # Spans piggyback on the lease metrics channel (keyed by agent
             # like the obs snapshot); undelivered batches requeue below.
             metrics["spans"] = spans
+        captures = self._drain_capture_results()
+        if captures:
+            # Deep-capture completions ride the same channel (ISSUE 9).
+            metrics["profile_captures"] = captures
         # Staging-pool grant ask: never below the configured MAX_TASKS, and
         # absent a pool hint exactly MAX_TASKS (the legacy wire).
         hint = self.lease_batch_hint
@@ -569,8 +651,10 @@ class Agent:
                 "metrics": metrics,
             },
         )
-        if status not in (200, 204) and spans:
-            self.tracer.requeue(spans)
+        if status not in (200, 204):
+            if spans:
+                self.tracer.requeue(spans)
+            self._requeue_capture_results(captures)
         if status == STATUS_TRANSPORT_ERROR:
             self.m_lease.inc(outcome="error")
             raise RuntimeError(f"lease transport error: {body}")
@@ -771,7 +855,8 @@ class Agent:
         return job_id, op, payload, epoch
 
     def _op_context(self, job_id: str, lease_id: Optional[str] = None,
-                    attempt: Any = None, parent_span_id: Any = None):
+                    attempt: Any = None, parent_span_id: Any = None,
+                    tenant: Any = None):
         from agent_tpu.runtime.context import OpContext
 
         # The trace triple stamped at lease time (ISSUE 2 tentpole 5): it
@@ -779,7 +864,11 @@ class Agent:
         # body, so one job's life greps across controller journal, agent
         # logs, and both flight recorders. `span_id` (ISSUE 5) is the
         # controller's lease span — the parent of the agent-side spans.
+        # `tenant` (ISSUE 9) rides only when the controller stamped one on
+        # the task, so multi-tenant attribution greps agent-side too.
         trace = {"job_id": job_id, "attempt": attempt, "lease_id": lease_id}
+        if isinstance(tenant, str) and tenant:
+            trace["tenant"] = tenant
         if parent_span_id:
             trace["span_id"] = parent_span_id
         tags: Dict[str, Any] = {"job_id": job_id, "trace": trace}
@@ -791,12 +880,106 @@ class Agent:
             runtime=self.runtime, config=self.config, tags=tags,
         )
 
+    def _drain_capture_results(self) -> List[Dict[str, Any]]:
+        """Completed deep-capture records awaiting their piggyback ship."""
+        out, self._capture_done = self._capture_done, []
+        return out
+
+    def _requeue_capture_results(
+        self, batch: List[Dict[str, Any]]
+    ) -> None:
+        """Undelivered completion batch goes back to the head — a capture
+        completion must survive a lost lease round like spans do."""
+        if batch:
+            self._capture_done = batch + self._capture_done
+
+    def _take_capture(self, op: str) -> Optional[Dict[str, Any]]:
+        """Pop the first pending capture matching ``op`` (a request without
+        an op matches the next task of any op)."""
+        for i, cap in enumerate(self._pending_captures):
+            want = cap.get("op")
+            if not want or want == op:
+                return self._pending_captures.pop(i)
+        return None
+
+    def _captured_call(
+        self, op: str, thunk: Any, cap: Dict[str, Any]
+    ) -> Any:
+        """One on-demand deep capture (ISSUE 9): wrap this op execution in
+        ``jax.profiler.trace`` writing into a per-capture artifact dir, and
+        queue the completion record (artifact path + summary) for the next
+        lease's metrics channel. A profiler that cannot start degrades to a
+        plain call with an ``error`` completion — diagnostics must never
+        fail the task they observe."""
+        import tempfile
+
+        record: Dict[str, Any] = {
+            "capture_id": cap.get("capture_id"),
+            "agent": self.config.agent.agent_name,
+            "op": op,
+            "status": "done",
+        }
+        try:
+            base = os.environ.get("PROFILE_CAPTURE_DIR", "").strip()
+            if base:
+                artifact = os.path.join(
+                    base, f"capture-{cap.get('capture_id')}"
+                )
+                os.makedirs(artifact, exist_ok=True)
+            else:
+                artifact = tempfile.mkdtemp(
+                    prefix=f"agent_tpu_capture_{cap.get('capture_id')}_"
+                )
+            import jax
+
+            prof = jax.profiler.trace(artifact)
+            prof.__enter__()
+        except Exception as exc:  # noqa: BLE001 — profiler failed to start:
+            # plain call, error completion; diagnostics never fail the task.
+            record.update(status="error", error=str(exc)[:300])
+            self._capture_done.append(record)
+            return thunk()
+        t0 = time.perf_counter()
+        try:
+            with jax.profiler.TraceAnnotation(f"op:{op}"):
+                return thunk()
+        except Exception:
+            record["status"] = "op_failed"  # trace still captured; op raised
+            raise
+        finally:
+            try:
+                prof.__exit__(None, None, None)
+            except Exception:  # noqa: BLE001 — a torn trace close is not
+                pass            # worth failing the op over
+            dt_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            n_files = sum(
+                len(files) for _, _, files in os.walk(artifact)
+            )
+            record.update(
+                artifact=artifact,
+                actual_duration_ms=dt_ms,
+                summary={"op": op, "n_trace_files": n_files,
+                         "duration_ms": dt_ms},
+            )
+            self._capture_done.append(record)
+            self.recorder.record(
+                "profile_capture", capture_id=record["capture_id"],
+                op=op, artifact=artifact, status=record["status"],
+            )
+            log("deep capture complete", op=op, artifact=artifact,
+                capture_id=record["capture_id"])
+
     def profiled_call(self, op: str, thunk: Any) -> Any:
         """Run ``thunk`` capturing an XProf trace for the first
         ``profile_tasks`` tasks when PROFILE_DIR is set (SURVEY.md §5.1 —
         result-embedded wall-clock timings flow regardless; traces are the
-        deep-dive channel). Shared by the serial loop and the pipelined
-        device loop so profiling covers phased ops too."""
+        deep-dive channel), or under an on-demand deep capture when one is
+        pending for this op (ISSUE 9). Shared by the serial loop and the
+        pipelined device loop so both cover phased ops too."""
+        if self._pending_captures:
+            cap = self._take_capture(op)
+            if cap is not None:
+                return self._captured_call(op, thunk, cap)
         dev = self.config.device
         if dev.profile_dir and self.tasks_done < dev.profile_tasks:
             import jax
@@ -873,7 +1056,9 @@ class Agent:
             return
 
         ctx = self._op_context(job_id, lease_id=lease_id, attempt=attempt,
-                               parent_span_id=span_parent)
+                               parent_span_id=span_parent,
+                               tenant=task.get("tenant")
+                               if isinstance(task, dict) else None)
         # The execute span id is minted up front so compile spans emitted
         # INSIDE the op (executor cache misses) can parent to it.
         exec_span_id = new_span_id()
@@ -890,6 +1075,7 @@ class Agent:
                 "stage", trace_id, span_parent,
                 start_mono=t0, duration_s=t_exec0 - t0, op=op,
             )
+            stamp_usage(ctx.tags, host_s=t_exec0 - t0)
             with use_context(TraceContext(
                 trace_id=trace_id or job_id,
                 parent_span_id=exec_span_id,
@@ -935,6 +1121,10 @@ class Agent:
             if ctx.tags.get("timings"):
                 result.setdefault("timings", ctx.tags["timings"])
             result.setdefault("trace", ctx.tags.get("trace"))
+            if ctx.tags.get("usage"):
+                # Usage block (ISSUE 9): device/host seconds, chips, FLOPs,
+                # rows — what the controller's showback ledger bills.
+                result.setdefault("usage", ctx.tags["usage"])
         t_post0 = time.perf_counter()
         self.post_result(
             lease_id, job_id, epoch, status, result=result, error=error, op=op

@@ -50,6 +50,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 from agent_tpu.obs.trace import TraceContext, new_span_id, use_context
+from agent_tpu.obs.usage import stamp_usage
 from agent_tpu.utils.errors import structured_error
 from agent_tpu.utils.logging import log
 
@@ -161,7 +162,9 @@ class PipelineRunner:
         item = _Item(
             lease_id, job_id, epoch, op, payload,
             agent._op_context(job_id, lease_id=lease_id, attempt=attempt,
-                              parent_span_id=span_parent),
+                              parent_span_id=span_parent,
+                              tenant=task.get("tenant")
+                              if isinstance(task, dict) else None),
             t0, fn=fn, trace_id=trace_id, span_parent=span_parent,
         )
         stage = getattr(fn, "stage", None)
@@ -186,6 +189,10 @@ class PipelineRunner:
             item.t_staged - t0,
             exemplar={"trace_id": job_id}, op=op, phase="stage",
         )
+        # Host-side usage attribution (ISSUE 9): stage seconds ride the
+        # result's usage block next to the device seconds the execute loop
+        # stamps.
+        stamp_usage(item.ctx.tags, host_s=item.t_staged - t0)
         # The runner's existing stage measurement, as a span (ISSUE 5).
         agent.trace_span(
             "stage", trace_id, span_parent,
@@ -420,6 +427,8 @@ class PipelineRunner:
             )
             duration_ms = (time.perf_counter() - item.t_start) * 1000.0
             if item.ctx is not None:
+                # Poster-thread host seconds join the stage stamp (ISSUE 9).
+                stamp_usage(item.ctx.tags, host_s=finalize_s)
                 timings = item.ctx.tags.setdefault("timings", {})
                 # Stamped here because finalize cannot time its own return;
                 # rides the result body so scrape-side attribution sees the
@@ -442,6 +451,12 @@ class PipelineRunner:
                     item.result.setdefault(
                         "trace", item.ctx.tags.get("trace")
                     )
+                    if item.ctx.tags.get("usage"):
+                        # Usage block (ISSUE 9): what the controller's
+                        # showback ledger bills for this task.
+                        item.result.setdefault(
+                            "usage", item.ctx.tags["usage"]
+                        )
             agent.post_result(
                 item.lease_id, item.job_id, item.epoch, item.status,
                 result=item.result, error=item.error, session=session,

@@ -384,6 +384,58 @@ class SloConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Resource accounting, trend retention & continuous profiling knobs
+    (ISSUE 9 — the USAGE_* / TSDB_* / PROFILE_* env surface).
+
+    Everything defaults ON with bounded memory: the ledger is a small
+    aggregate map + a capped per-job table, the time-series ring holds
+    ``window/interval`` flattened samples, and the host profiler starts
+    LAZILY on the first ``GET /v1/profile/host`` (a controller that is never
+    asked for a flamegraph never spawns the sampler thread)."""
+
+    # Usage accounting (GET /v1/usage): per-{tenant,tier,op} + per-job
+    # billing of accepted result applications.
+    usage_enabled: bool = True             # USAGE_ENABLED
+    usage_top_k: int = 10                  # USAGE_TOP_K (top jobs in report)
+    usage_max_jobs: int = 4096             # USAGE_MAX_JOBS (per-job table cap)
+    # $/chip-hour for the report's est_cost lines; 0 = no cost estimate.
+    usage_cost_per_chip_hour: float = 0.0  # USAGE_COST_PER_CHIP_HOUR
+    # Controller time-series ring (GET /v1/timeseries): periodic registry
+    # snapshots spanning TSDB_WINDOW at TSDB_INTERVAL cadence.
+    tsdb_enabled: bool = True              # TSDB_ENABLED
+    tsdb_window_sec: float = 900.0         # TSDB_WINDOW
+    tsdb_interval_sec: float = 10.0        # TSDB_INTERVAL
+    # Host sampling profiler (GET /v1/profile/host): collapsed-stack
+    # flamegraph of the controller process, lazily started.
+    profile_host_enabled: bool = True      # PROFILE_HOST_ENABLED
+    profile_host_hz: float = 19.0          # PROFILE_HOST_HZ
+    # Where agents write on-demand jax.profiler capture artifacts
+    # ("" = a per-capture tempdir).
+    profile_capture_dir: str = ""          # PROFILE_CAPTURE_DIR
+
+    @staticmethod
+    def from_env() -> "ObsConfig":
+        interval = max(0.05, env_float("TSDB_INTERVAL", 10.0))
+        return ObsConfig(
+            usage_enabled=env_bool("USAGE_ENABLED", True),
+            usage_top_k=max(1, env_int("USAGE_TOP_K", 10)),
+            usage_max_jobs=max(16, env_int("USAGE_MAX_JOBS", 4096)),
+            usage_cost_per_chip_hour=max(
+                0.0, env_float("USAGE_COST_PER_CHIP_HOUR", 0.0)
+            ),
+            tsdb_enabled=env_bool("TSDB_ENABLED", True),
+            tsdb_window_sec=max(
+                interval, env_float("TSDB_WINDOW", 900.0)
+            ),
+            tsdb_interval_sec=interval,
+            profile_host_enabled=env_bool("PROFILE_HOST_ENABLED", True),
+            profile_host_hz=max(0.1, env_float("PROFILE_HOST_HZ", 19.0)),
+            profile_capture_dir=env_str("PROFILE_CAPTURE_DIR", "").strip(),
+        )
+
+
+@dataclass(frozen=True)
 class OpsConfig:
     """Per-op knobs (reference ``ops/map_summarize.py:9-10``, trigger envs)."""
 

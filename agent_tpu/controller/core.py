@@ -61,9 +61,12 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
-from agent_tpu.config import TRUTHY_TOKENS, SchedConfig, SloConfig
+from agent_tpu.config import ObsConfig, TRUTHY_TOKENS, SchedConfig, SloConfig
 from agent_tpu.data import wire
 from agent_tpu.obs.health import build_health
+from agent_tpu.obs.profile import CaptureCoordinator, HostProfiler
+from agent_tpu.obs.timeseries import TimeSeriesRing
+from agent_tpu.obs.usage import UsageLedger
 from agent_tpu.obs.metrics import (
     MetricsRegistry,
     histogram_quantile,
@@ -175,6 +178,12 @@ class Job:
             # greps across journal, agent logs, and both flight recorders.
             "attempt": self.attempts,
         }
+        if self.tenant != DEFAULT_TENANT:
+            # Tenant plumb-through (ISSUE 9): agents stamp it into their
+            # trace tags so per-tenant attribution greps across agent logs
+            # and flight recorders too. Appended only when non-default —
+            # single-tenant drains keep the exact legacy task bytes.
+            task["tenant"] = self.tenant
         if self.lease_span_id is not None:
             # Causal parenting (ISSUE 5): agent-side stage/execute/post
             # spans hang off the lease span. Absent when tracing is off,
@@ -201,6 +210,7 @@ class Controller:
         trace_store: Optional[TraceStore] = None,
         wire_binary: bool = True,
         slo: Optional[SloConfig] = None,
+        obs: Optional[ObsConfig] = None,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
         # Binary shard wire (ISSUE 6): False = never negotiate (a JSON-only
@@ -331,6 +341,34 @@ class Controller:
                 burn_exit_frac=self.slo_config.burn_exit_frac,
                 on_alert=self._on_slo_alert,
             )
+        # Resource accounting & continuous profiling (ISSUE 9): the showback
+        # ledger billed at result-apply time, the trend ring sampled from
+        # sweep/lease, on-demand deep-capture bookkeeping riding the lease
+        # alerts channel, and a lazily-started host sampling profiler.
+        # USAGE_ENABLED=0 / TSDB_ENABLED=0 leave the members None and no-op
+        # every touch point (no families registered, no journal keys).
+        self.obs_config = obs if obs is not None else ObsConfig()
+        self.usage: Optional[UsageLedger] = None
+        if self.obs_config.usage_enabled:
+            self.usage = UsageLedger(
+                registry=self.metrics,
+                top_k=self.obs_config.usage_top_k,
+                max_jobs=self.obs_config.usage_max_jobs,
+                cost_per_chip_hour=self.obs_config.usage_cost_per_chip_hour,
+            )
+        self.tsdb: Optional[TimeSeriesRing] = None
+        if self.obs_config.tsdb_enabled:
+            self.tsdb = TimeSeriesRing(
+                window_sec=self.obs_config.tsdb_window_sec,
+                interval_sec=self.obs_config.tsdb_interval_sec,
+                clock=self._clock,
+            )
+        self.captures = CaptureCoordinator()
+        # Built on first GET /v1/profile/host (a controller never asked for
+        # a flamegraph never spawns the sampler thread — tests construct
+        # hundreds of Controllers).
+        self.host_profiler: Optional[HostProfiler] = None
+        self._host_profiler_lock = threading.Lock()
         # The policy object every lease decision delegates to (ISSUE 4).
         self._sched = make_scheduler(
             self.sched_config, on_decision=self._on_sched_decision
@@ -604,6 +642,17 @@ class Controller:
                 job.attempts = int(ev.get("attempts", job.attempts))
                 job.result = ev.get("result")
                 job.error = ev.get("error")
+                if self.usage is not None and isinstance(
+                    ev.get("usage"), dict
+                ):
+                    # Replay-correct showback (ISSUE 9): billed usage rides
+                    # the result event, so a restarted controller's
+                    # /v1/usage reports the same totals the dead one did.
+                    self.usage.bill(
+                        job.job_id, tenant=job.tenant, tier=job.priority,
+                        op=job.op, attempt=ev.get("attempts", 0),
+                        usage=ev["usage"],
+                    )
             elif ev.get("ev") == "requeue":
                 # Lease-expiry epoch bump: must replay, or a result the
                 # previous incarnation had fenced off could be accepted
@@ -665,6 +714,18 @@ class Controller:
             # is the no-traffic evaluation cadence. Outside the lock: the
             # alert hook does file I/O on page entry.
             self.slo.evaluate()
+        # Trend ring (ISSUE 9): the sweeper is the steady sampling cadence;
+        # the lease path backstops it under sweeper-less tests/drains.
+        self._tsdb_sample()
+
+    def _tsdb_sample(self) -> None:
+        """Rate-limited time-series sample (controller registry + fleet
+        merge). Runs OUTSIDE the controller lock — fleet_snapshot takes it —
+        and costs one clock read when no sample is due."""
+        if self.tsdb is not None:
+            self.tsdb.maybe_sample(
+                lambda: (self.metrics.snapshot(), self.fleet_snapshot())
+            )
 
     def start_sweeper(self, interval_sec: float = 5.0) -> None:
         """TTL enforcement without traffic: a daemon thread sweeping every
@@ -685,6 +746,8 @@ class Controller:
 
     def close(self) -> None:
         """Stop the sweeper and close the journal (idempotent)."""
+        if self.host_profiler is not None:
+            self.host_profiler.stop()
         self._sweep_stop.set()
         if self._sweeper is not None:
             self._sweeper.join(timeout=5)
@@ -1140,6 +1203,30 @@ class Controller:
         labels: Optional[Dict[str, Any]] = None,
         **_ignored: Any,
     ) -> Optional[Dict[str, Any]]:
+        try:
+            return self._lease_impl(
+                agent, capabilities=capabilities, max_tasks=max_tasks,
+                worker_profile=worker_profile, metrics=metrics,
+                labels=labels, **_ignored,
+            )
+        finally:
+            # Trend-ring backstop (ISSUE 9): AFTER the lease, so the sample
+            # sees the telemetry this very poll ingested (the metrics-only
+            # drain-end flush is what carries the final counters). Rate-
+            # limited to TSDB_INTERVAL — one clock read per lease between
+            # samples — and outside the controller lock by construction.
+            self._tsdb_sample()
+
+    def _lease_impl(
+        self,
+        agent: str,
+        capabilities: Optional[Dict[str, Any]] = None,
+        max_tasks: int = 1,
+        worker_profile: Optional[Dict[str, Any]] = None,
+        metrics: Optional[Dict[str, Any]] = None,
+        labels: Optional[Dict[str, Any]] = None,
+        **_ignored: Any,
+    ) -> Optional[Dict[str, Any]]:
         """One lease request → ``{lease_id, tasks}`` or None (HTTP 204).
 
         ``max_tasks < 1`` is a **metrics-only poll**: the agent's telemetry
@@ -1188,6 +1275,12 @@ class Controller:
                     if isinstance(metrics, dict) else None
                 if piggyback:
                     self.traces.ingest(piggyback)
+                # Deep-capture completions ride the same channel (ISSUE 9):
+                # popped so the stored per-agent snapshot stays clean.
+                done_captures = metrics.pop("profile_captures", None) \
+                    if isinstance(metrics, dict) else None
+                for payload in done_captures or []:
+                    self.captures.complete(payload)
                 self.last_metrics = metrics
                 if agent:
                     self.agent_metrics[agent] = {
@@ -1359,11 +1452,15 @@ class Controller:
                 return None
             self._m_lease.inc(outcome="granted")
             out = {"lease_id": lease_id, "tasks": tasks}
-            if page_alerts:
-                # Only when something is paging: the wire stays byte-
-                # identical to the pre-health protocol otherwise, and old
-                # agents ignore the extra key either way.
-                out["alerts"] = page_alerts
+            # Pending deep-capture requests for THIS agent ride granted
+            # leases only (ISSUE 9) — a capture wraps an op execution, so
+            # delivering alongside tasks is the natural (and only) slot.
+            alerts = page_alerts + self.captures.pending_for(agent)
+            if alerts:
+                # Only when something is paging or a capture is pending:
+                # the wire stays byte-identical to the pre-health protocol
+                # otherwise, and old agents ignore the extra key either way.
+                out["alerts"] = alerts
             if wire_fmt:
                 # The negotiation answer: the agent may now binary-encode
                 # its result columns. Stamped on every negotiated grant so
@@ -1380,13 +1477,18 @@ class Controller:
         result: Any = None,
         error: Any = None,
         spans: Any = None,
+        wire_bytes: int = 0,
         **_ignored: Any,
     ) -> Dict[str, Any]:
         """One result post. Stale epochs are counted and discarded.
 
         ``spans`` is the agent's piggybacked span batch (ISSUE 5) — ingested
         regardless of whether the result is accepted (a fenced result's
-        execution still happened and belongs on the timeline)."""
+        execution still happened and belongs on the timeline).
+
+        ``wire_bytes`` is the HTTP layer's measured request size (ISSUE 9):
+        the exact per-task result-wire attribution the usage ledger bills —
+        0 for in-process sessions, which simply have no wire."""
         if spans:
             self.traces.ingest(spans)
         if wire.is_binary_result(result):
@@ -1525,6 +1627,19 @@ class Controller:
                 # Transient-failure requeue: the next sched.decide span
                 # measures its wait from here.
                 job.enqueued_clock = now
+            # Showback billing (ISSUE 9): every ACCEPTED application bills
+            # once — fenced/duplicate posts already returned above, and the
+            # ledger's (job, attempt) dedupe makes double-billing
+            # structurally impossible even across replay + live overlap.
+            billed_usage = None
+            if self.usage is not None:
+                billed_usage = self.usage.bill(
+                    job.job_id, tenant=job.tenant, tier=job.priority,
+                    op=job.op, attempt=job.attempts,
+                    usage=result.get("usage")
+                    if isinstance(result, dict) else None,
+                    wire_bytes=wire_bytes,
+                )
             # Journal the post-decision state (not the raw report): replay
             # applies it verbatim, so a failed-then-requeued job replays as
             # pending at the bumped epoch and a completed shard stays done.
@@ -1532,19 +1647,22 @@ class Controller:
             # will need them as partials after a restart) — journaling every
             # drain shard's output would make the journal an unbounded second
             # copy of the dataset.
-            self._journal(
-                {
-                    "ev": "result",
-                    "job_id": job.job_id,
-                    "state": job.state,
-                    "epoch": job.epoch,
-                    "attempts": job.attempts,
-                    "result": (
-                        job.result if job.job_id in self._depended_on else None
-                    ),
-                    "error": job.error,
-                }
-            )
+            record = {
+                "ev": "result",
+                "job_id": job.job_id,
+                "state": job.state,
+                "epoch": job.epoch,
+                "attempts": job.attempts,
+                "result": (
+                    job.result if job.job_id in self._depended_on else None
+                ),
+                "error": job.error,
+            }
+            if billed_usage is not None:
+                # Appended only when billed (journal schema vN+1 rule):
+                # usage-less drains keep writing the exact legacy bytes.
+                record["usage"] = billed_usage
+            self._journal(record)
             return {"accepted": True}
 
     def note_http_bytes(self, route: str, direction: str, n: int) -> None:
@@ -1714,6 +1832,75 @@ class Controller:
     def traces_json(self, limit: int = 20) -> List[Dict[str, Any]]:
         """Newest-first trace summaries (``GET /v1/traces?limit=N``)."""
         return self.traces.summaries(limit)
+
+    # ---- resource accounting & profiling surface (ISSUE 9) ----
+
+    def usage_json(self, top_k: Optional[int] = None) -> Dict[str, Any]:
+        """The ``GET /v1/usage`` body: billed totals per tenant/tier/op,
+        top-K jobs by device seconds, and the LIVE per-tenant queue depth so
+        consumed and still-pending demand read off one report."""
+        if self.usage is None:
+            return {"enabled": False}
+        with self._lock:
+            pending = self._sched.depth_by_tenant()
+        return self.usage.report(top_k=top_k, pending_by_tenant=pending)
+
+    def timeseries_json(
+        self,
+        name: str,
+        label_filter: Optional[Dict[str, str]] = None,
+        rate: bool = False,
+        window_sec: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """The ``GET /v1/timeseries`` body. Unknown names and an empty ring
+        return an empty ``series`` list, never an error."""
+        if self.tsdb is None:
+            return {"enabled": False, "name": name, "series": []}
+        out = self.tsdb.query(
+            name, label_filter, rate=rate, window_sec=window_sec
+        )
+        out["enabled"] = True
+        return out
+
+    def timeseries_names(self) -> List[str]:
+        return self.tsdb.names() if self.tsdb is not None else []
+
+    def request_capture(
+        self,
+        agent: str,
+        op: Optional[str] = None,
+        duration_ms: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Arm one on-demand ``jax.profiler`` deep capture (``POST
+        /v1/profile/capture``); the request rides the target agent's next
+        granted lease via the ``alerts`` channel."""
+        return self.captures.request(agent, op=op, duration_ms=duration_ms)
+
+    def captures_json(self) -> Dict[str, Any]:
+        return {"captures": self.captures.snapshot()}
+
+    def host_profile_text(self) -> Optional[str]:
+        """Collapsed-stack flamegraph text of THIS process (``GET
+        /v1/profile/host``), or None when disabled. The sampler thread
+        starts lazily on the first request; the first response still
+        carries ≥1 real sample (one synchronous walk if the thread hasn't
+        beaten yet)."""
+        if not self.obs_config.profile_host_enabled:
+            return None
+        with self._host_profiler_lock:
+            if self.host_profiler is None:
+                self.host_profiler = HostProfiler(
+                    hz=self.obs_config.profile_host_hz
+                ).start()
+            prof = self.host_profiler
+        if prof.n_samples == 0:
+            prof.sample_once()
+        return prof.collapsed()
+
+    def host_profile_stats(self) -> Optional[Dict[str, Any]]:
+        if self.host_profiler is None:
+            return None
+        return self.host_profiler.stats()
 
     def status_summary(self) -> Dict[str, Any]:
         """Structured rollup for /v1/status: per-op task counts + throughput

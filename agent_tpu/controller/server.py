@@ -100,6 +100,9 @@ class _Handler(BaseHTTPRequestHandler):
                 # Piggybacked agent spans (ISSUE 5) — optional, absent from
                 # legacy agents.
                 spans=body.get("spans"),
+                # Per-task result-wire attribution (ISSUE 9): the measured
+                # request size, billed into the usage ledger.
+                wire_bytes=self._request_bytes,
             )
             n_out = self._send(200, out)
             # Result bodies arrive on this route — the other half of the
@@ -187,6 +190,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(data)
             except (KeyError, ValueError, TypeError) as exc:
                 self._send(400, {"error": str(exc)})
+        elif self.path == "/v1/profile/capture":
+            # On-demand deep capture (ISSUE 9): arm one jax.profiler trace
+            # on the named agent; the request rides its next granted lease.
+            try:
+                out = self.controller.request_capture(
+                    agent=body.get("agent"),
+                    op=body.get("op"),
+                    duration_ms=body.get("duration_ms"),
+                )
+                self._send(200, {"capture_id": out["capture_id"],
+                                 "capture": out})
+            except (ValueError, TypeError) as exc:
+                self._send(400, {"error": str(exc)})
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
@@ -239,6 +255,61 @@ class _Handler(BaseHTTPRequestHandler):
                     "capacity": self.controller.recorder.capacity,
                 },
             )
+            return
+        if path == "/v1/usage":
+            # Showback report (ISSUE 9): billed device/host seconds, FLOPs,
+            # rows, and wire bytes per tenant/tier/op + top-K jobs + the
+            # live per-tenant queue depth. ?top_k=N resizes the job list.
+            try:
+                top_k = (
+                    int(query["top_k"][0]) if "top_k" in query else None
+                )
+            except ValueError:
+                self._send(400, {"error": "top_k must be an int"})
+                return
+            self._send(200, self.controller.usage_json(top_k=top_k))
+            return
+        if path == "/v1/timeseries":
+            # Controller trend ring (ISSUE 9): ?name=<family> (required),
+            # ?rate=1 for per-second deltas, ?window_sec=N to narrow, and
+            # any other query key=value pairs filter series labels
+            # (?op=map_classify_tpu&tenant=a).
+            name = query.get("name", [None])[0]
+            if not name:
+                self._send(400, {
+                    "error": "name is required",
+                    "names": self.controller.timeseries_names(),
+                })
+                return
+            try:
+                window = (
+                    float(query["window_sec"][0])
+                    if "window_sec" in query else None
+                )
+            except ValueError:
+                self._send(400, {"error": "window_sec must be a number"})
+                return
+            rate = query.get("rate", ["0"])[0] in ("1", "true", "yes")
+            label_filter = {
+                k: v[0] for k, v in query.items()
+                if k not in ("name", "rate", "window_sec") and v
+            }
+            self._send(200, self.controller.timeseries_json(
+                name, label_filter or None, rate=rate, window_sec=window,
+            ))
+            return
+        if path == "/v1/profile/host":
+            # Host sampling profiler (ISSUE 9): collapsed-stack flamegraph
+            # text of the controller process (flamegraph.pl format).
+            text = self.controller.host_profile_text()
+            if text is None:
+                self._send(404, {"error": "host profiler disabled "
+                                          "(PROFILE_HOST_ENABLED=0)"})
+                return
+            self._send_text(200, text, "text/plain; charset=utf-8")
+            return
+        if path == "/v1/profile/captures":
+            self._send(200, self.controller.captures_json())
             return
         if path == "/v1/health":
             # Fleet health verdict (ISSUE 8): per-tier SLO attainment +
@@ -339,6 +410,7 @@ def main() -> int:
     import signal
 
     from agent_tpu.config import (
+        ObsConfig,
         SchedConfig,
         SloConfig,
         env_bool,
@@ -366,6 +438,9 @@ def main() -> int:
         # SLO_* / HEALTH_* knobs (ISSUE 8): declarative objectives, burn
         # thresholds, windows; SLO_ENABLED=0 no-ops the judgment path.
         slo=SloConfig.from_env(),
+        # USAGE_* / TSDB_* / PROFILE_* knobs (ISSUE 9): showback ledger,
+        # trend ring, host profiler, on-demand deep captures.
+        obs=ObsConfig.from_env(),
     )
     server = ControllerServer(controller, host=host, port=port)
     stop = threading.Event()

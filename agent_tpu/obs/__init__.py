@@ -33,12 +33,20 @@ from agent_tpu.obs.recorder import (
     get_recorder,
     install_sigusr1_dump,
 )
+from agent_tpu.obs.profile import (
+    CaptureCoordinator,
+    HostProfiler,
+    device_memory_stats,
+    hbm_totals,
+)
 from agent_tpu.obs.slo import (
     DEFAULT_SLO_SPEC,
     Objective,
     SloTracker,
     parse_slo_spec,
 )
+from agent_tpu.obs.timeseries import TimeSeriesRing, points_to_rates
+from agent_tpu.obs.usage import UsageLedger, sanitize_usage, stamp_usage
 from agent_tpu.obs.trace import (
     Span,
     SpanBuffer,
@@ -49,8 +57,17 @@ from agent_tpu.obs.trace import (
 )
 
 __all__ = [
+    "CaptureCoordinator",
     "DEFAULT_SLO_SPEC",
+    "HostProfiler",
     "Objective",
+    "TimeSeriesRing",
+    "UsageLedger",
+    "device_memory_stats",
+    "hbm_totals",
+    "points_to_rates",
+    "sanitize_usage",
+    "stamp_usage",
     "RollingWindow",
     "SloTracker",
     "build_health",

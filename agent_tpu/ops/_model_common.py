@@ -75,6 +75,20 @@ def stamp_device_flops(ctx, flops: float, shape: str) -> None:
     attr["shape"] = str(shape)
 
 
+def stamp_rows(ctx, rows: Any) -> None:
+    """Accumulate the rows this task processed into the result's usage
+    block (ISSUE 9) — the numerator of the showback report's rows column
+    and swarmtop's rows/s sparkline. No-op without a ctx or a positive
+    count (pure-op callers, empty shards)."""
+    if ctx is None or not hasattr(ctx, "tags"):
+        return
+    if isinstance(rows, bool) or not isinstance(rows, int) or rows <= 0:
+        return
+    from agent_tpu.obs.usage import stamp_usage
+
+    stamp_usage(ctx.tags, rows=rows)
+
+
 def resolve_model_id(payload: Dict[str, Any], env_var: str, default: str) -> str:
     """payload ``model_path`` → env var → default (ref ``_tpu_runtime.py:23-31``)."""
     mp = payload.get("model_path")

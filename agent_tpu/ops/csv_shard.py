@@ -55,10 +55,13 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
 
     # Reference wire-contract fields (reference ``ops/csv_shard.py:55,86-103``)
     # ride alongside ours: dataset_id echo, end_row, row_count.
+    from agent_tpu.ops._model_common import stamp_rows
+
     dataset_id = payload.get("dataset_id", "unknown_dataset")
     total = index.n_data_rows
     if mode == "count":
         in_range = max(0, min(shard_size, total - start_row))
+        stamp_rows(ctx, in_range)
         return {
             "ok": True,
             "mode": "count",
@@ -73,6 +76,7 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         }
 
     rows = index.read_dict_rows(start_row, shard_size)
+    stamp_rows(ctx, len(rows))
     return {
         "ok": True,
         "mode": "rows",

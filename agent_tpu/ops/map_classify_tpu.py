@@ -708,6 +708,9 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
                 if "fetch_ms" in state else {}
             ),
         )
+    from agent_tpu.ops._model_common import stamp_rows
+
+    stamp_rows(ctx, state["n_rows"])
     out: Dict[str, Any] = {
         "ok": True,
         "op": "map_classify_tpu",

@@ -558,6 +558,9 @@ def finalize(state: Dict[str, Any], ctx: Optional[object] = None) -> Dict[str, A
             fetch_ms=round(fetch_ms, 3),
         )
 
+    from agent_tpu.ops._model_common import stamp_rows
+
+    stamp_rows(ctx, len(summaries))
     out: Dict[str, Any] = {
         "ok": True,
         # Explicit op attribution (ISSUE 2 satellite): the reference shape

@@ -173,6 +173,12 @@ def run(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
         values = _extract_values(payload)
     except ValueError as exc:
         return bad_input(str(exc))
+    # Usage rows (ISSUE 9): the MAP path counts its shard's values; the
+    # partials merge above deliberately does not — those rows were already
+    # counted by the shard tasks that produced the partials.
+    from agent_tpu.ops._model_common import stamp_rows
+
+    stamp_rows(ctx, len(values))
     if not values:
         return _zero_result(t0)
 

@@ -368,13 +368,25 @@ class TpuRuntime:
             # owns — rides the lease telemetry so the controller's fleet
             # view can attribute chips per agent.
             out["chip_slice"] = self.config.chip_slice
+        # HBM telemetry across ALL owned devices (ISSUE 9 satellite — the
+        # old probe read only devices[0], so a CHIP_SLICE fleet member or
+        # dp=N mesh agent attributed memory for one chip out of N). The
+        # legacy keys become fleet-correct TOTALS; the per-device breakdown
+        # rides alongside. Absent entirely on backends without stats (CPU).
+        from agent_tpu.obs.profile import hbm_totals
+
         try:
-            mem = self.devices[0].memory_stats()
-            if mem:
-                out["hbm_bytes_in_use"] = int(mem.get("bytes_in_use", 0))
-                out["hbm_bytes_limit"] = int(mem.get("bytes_limit", 0))
-        except Exception:  # noqa: BLE001 — memory_stats unsupported on cpu
-            pass
+            hbm = hbm_totals(self.devices)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            hbm = None
+        if hbm:
+            if "used" in hbm:
+                out["hbm_bytes_in_use"] = hbm["used"]
+            if "limit" in hbm:
+                out["hbm_bytes_limit"] = hbm["limit"]
+            if "peak" in hbm:
+                out["hbm_peak_bytes"] = hbm["peak"]
+            out["hbm_per_device"] = hbm["per_device"]
         return out
 
 

@@ -174,12 +174,23 @@ def detect_tpu(device_cfg: Optional[DeviceConfig] = None) -> Dict[str, Any]:
             out["max_tpu_workers"] = 1
             out["n_chips"] = len(tpus)
             out["device_kind"] = tpus[0].device_kind
-            try:
-                mem = tpus[0].memory_stats() or {}
-                if mem.get("bytes_limit"):
-                    out["hbm_bytes_per_chip"] = int(mem["bytes_limit"])
-            except Exception:  # noqa: BLE001 — memory_stats optional
-                pass
+            # Probe ALL chips, not just tpus[0] (ISSUE 9 satellite): sizing
+            # derives batch hints from per-chip HBM, and a heterogeneous or
+            # partially-reporting slice must size to the SMALLEST chip —
+            # the conservative bound that never overflows a member.
+            limits: List[int] = []
+            for dev in tpus:
+                try:
+                    mem = dev.memory_stats() or {}
+                except Exception:  # noqa: BLE001 — memory_stats optional
+                    continue
+                if isinstance(mem, dict) and mem.get("bytes_limit"):
+                    limits.append(int(mem["bytes_limit"]))
+            if limits:
+                out["hbm_bytes_per_chip"] = min(limits)
+                out["hbm_bytes_total"] = sum(limits)
+                if len(limits) != len(tpus):
+                    out["hbm_probed_chips"] = len(limits)
         else:
             out["backend_platform"] = devices[0].platform if devices else None
     except Exception as exc:  # noqa: BLE001 — no jax / no backend ⇒ no TPU

@@ -1027,11 +1027,25 @@ def _bench_drain(runtime, n_rows: int = DRAIN_ROWS,
             for row in (health.get("agents") or {}).values():
                 for op, v in (row.get("mfu") or {}).items():
                     mfu_by_op[op] = v
+            # Usage showback rollup (ISSUE 9): the mixed leg's billed
+            # device/host seconds and rows off GET /v1/usage — an
+            # unreachable report fails the leg like an unreachable health.
+            from agent_tpu.obs.scrape import fetch_json as _fetch_json
+
+            usage = _fetch_json(server.url, "/v1/usage")
+            assert isinstance(usage, dict) and usage.get("enabled"), (
+                "usage path broken: GET /v1/usage unreachable for a "
+                "drained leg"
+            )
             total_rows = n_rows + DRAIN_SUMMARIZE_ROWS
             mixed_leg = {
                 "health_verdict": health["verdict"],
                 "slo_attainment": slo_attain,
                 "mfu": mfu_by_op or None,
+                "usage_device_seconds": usage["totals"]["device_seconds"],
+                "usage_host_seconds": usage["totals"]["host_seconds"],
+                "usage_rows": usage["totals"]["rows"],
+                "usage_billed_tasks": usage["billed_tasks"],
                 "rows_per_sec": round(total_rows / wall, 1),
                 "classify_rows": n_rows,
                 "summarize_rows": DRAIN_SUMMARIZE_ROWS,
@@ -1541,6 +1555,11 @@ def main() -> int:
                 "mfu_summarize": (
                     legs.get("drain_mixed", {}).get("mfu") or {}
                 ).get("map_summarize"),
+                # Resource accounting flat fields (ISSUE 9): billed device
+                # seconds + rows off GET /v1/usage for the mixed drain leg.
+                "usage_device_seconds": legs.get("drain_mixed", {})
+                .get("usage_device_seconds"),
+                "usage_rows": legs.get("drain_mixed", {}).get("usage_rows"),
             }
         ),
         flush=True,

@@ -45,8 +45,10 @@ from agent_tpu.controller.core import TERMINAL_STATES, Controller
 from agent_tpu.obs.metrics import MetricsRegistry
 
 # Timing fields legitimately differ run to run; everything else in the
-# reduce result must match bit for bit.
-VOLATILE_KEYS = ("compute_time_ms", "duration_ms", "timings", "trace")
+# reduce result must match bit for bit. `usage` (ISSUE 9) is wall-clock
+# seconds by definition — volatile like the timings it rides beside.
+VOLATILE_KEYS = ("compute_time_ms", "duration_ms", "timings", "trace",
+                 "usage")
 
 
 def canonical(result: Any) -> str:
@@ -296,6 +298,27 @@ def run_chaos(
             f"seed {seed}: accepted successes {accepted} != jobs {n_jobs} "
             "(a result was applied twice or lost)"
         )
+    # Usage billing exactly-once (ISSUE 9): under duplicate deliveries,
+    # stale epochs, and crash-retries, every job bills exactly ONE result
+    # application into the showback ledger — billed task count matches the
+    # accepted successes, and no job carries more than one billed attempt.
+    if controller.usage is not None:
+        billed = controller.usage.billed_tasks
+        if billed != n_jobs:
+            problems.append(
+                f"seed {seed}: usage billed {billed} tasks != jobs {n_jobs} "
+                "(a retry/duplicate double-billed or a result went unbilled)"
+            )
+        multi = {
+            jid: n
+            for jid, n in controller.usage.job_billed_attempts().items()
+            if n != 1
+        }
+        if multi:
+            problems.append(
+                f"seed {seed}: jobs billed != once: {multi}"
+            )
+
     # Every duplicate delivery must surface as a counted rejection; the
     # epoch fence + duplicate guard are the only things standing between an
     # at-least-once transport and double application.

@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+"""Bench trend guard: compare a bench run's flat fields against the best
+prior ``BENCH_r*.json`` artifact (ISSUE 9 satellite).
+
+Every bench round records flat trend fields (``e2e_drain_rows_per_sec``,
+``bert_base_mfu``, ``classify_p50_batch_ms``, ...) precisely so regressions
+would be visible — but nothing ever *compared* them, so a regression only
+surfaced if a reviewer happened to diff two JSON artifacts by eye. This
+script closes the loop:
+
+- the CURRENT run is ``--current FILE`` (a ``BENCH_r*.json`` artifact or a
+  raw ``bench.py`` stdout JSON line); default = the highest-numbered
+  repo-root ``BENCH_r*.json`` with a parseable payload;
+- the BASELINE per field is the best value any PRIOR artifact recorded
+  (max for rates/ratios, min for latency/size fields) — one lucky round
+  sets the bar, one noisy round cannot lower it;
+- a field regresses when it falls outside the per-field tolerance
+  (``--tolerance`` default 15%, wider for the known-noisy drain legs);
+  regressions exit 1 with a readable diff, one line per field.
+
+``--advisory`` reports but always exits 0 — the CI mode on CPU shapes,
+where absolute numbers measure the runner, not the code (the ISSUE 9
+acceptance bar: CI-wired, advisory on CPU). Run it strict on real TPU
+hardware after a bench round.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Fields where SMALLER is better; everything else numeric is a rate/ratio.
+LOWER_BETTER = {
+    "classify_p50_batch_ms",
+    "wire_bytes_per_row",
+}
+
+# Fields that are identity/config, not performance — never judged.
+SKIP_FIELDS = {
+    "n_chips",
+    "multichip_n_chips",
+    "value",          # duplicate of the flagship flat field
+    "vs_baseline",    # derived from `value`
+}
+
+# Known-noisy legs get a wider default band (measured spreads: flagship
+# 11.7%, long_ctx 14.0% at windows=3 — see bench.py's NOISY_WINDOWS note).
+PER_FIELD_TOLERANCE = {
+    "e2e_drain_rows_per_sec": 0.25,
+    "drain_staged_rows_per_sec": 0.25,
+    "multichip_rows_per_sec": 0.25,
+    "multichip_scaling_efficiency": 0.25,
+    "long_ctx_rows_per_sec": 0.25,
+    "csv_index_mb_per_sec": 0.25,
+}
+
+
+def bench_round(path: str) -> int:
+    m = re.search(r"BENCH_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def load_flat_fields(path: str) -> Optional[Dict[str, float]]:
+    """Numeric top-level fields of one artifact. Handles both the driver
+    wrapper shape (``{"parsed": {...}}``) and a raw bench stdout JSON;
+    returns None when the payload is missing/unparseable (BENCH_r04/r05
+    record ``parsed: null`` — a real state this must tolerate)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(doc, dict) and "parsed" in doc:
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        return None
+    out: Dict[str, float] = {}
+    for key, value in doc.items():
+        if key in SKIP_FIELDS:
+            continue
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        out[key] = float(value)
+    return out or None
+
+
+def best_prior(
+    artifacts: List[Tuple[int, str, Dict[str, float]]], field: str
+) -> Optional[Tuple[float, str]]:
+    """(best value, source artifact) for one field across prior rounds."""
+    best: Optional[Tuple[float, str]] = None
+    pick = min if field in LOWER_BETTER else max
+    for _, path, fields in artifacts:
+        if field not in fields:
+            continue
+        v = fields[field]
+        if best is None or pick(v, best[0]) == v:
+            best = (v, os.path.basename(path))
+    return best
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--current", default="",
+                    help="artifact or raw bench-JSON to judge (default: "
+                         "the newest parseable repo-root BENCH_r*.json)")
+    ap.add_argument("--baseline-glob",
+                    default=os.path.join(REPO, "BENCH_r*.json"))
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="default allowed fractional regression per field")
+    ap.add_argument("--advisory", action="store_true",
+                    help="report regressions but exit 0 (the CI mode on "
+                         "CPU shapes)")
+    args = ap.parse_args(argv)
+
+    rounds = sorted(
+        (bench_round(p), p, load_flat_fields(p))
+        for p in glob.glob(args.baseline_glob)
+    )
+    parseable = [(n, p, f) for n, p, f in rounds if f is not None]
+    if not parseable:
+        print("check_bench_regression: no parseable BENCH_r*.json artifacts"
+              " — nothing to judge")
+        return 0
+
+    if args.current:
+        current_path = args.current
+        current = load_flat_fields(current_path)
+        priors = parseable
+    else:
+        n, current_path, current = parseable[-1]
+        priors = [e for e in parseable if e[0] != n]
+    if current is None:
+        print(f"check_bench_regression: {current_path} has no parseable "
+              "flat fields")
+        return 0 if args.advisory else 1
+    if not priors:
+        print(f"check_bench_regression: {os.path.basename(current_path)} is "
+              "the only parseable artifact — baseline established, "
+              "nothing to compare")
+        return 0
+
+    regressions: List[str] = []
+    improved = judged = 0
+    for field in sorted(current):
+        base = best_prior(priors, field)
+        if base is None:
+            continue  # new field this round — becomes the baseline
+        judged += 1
+        baseline, source = base
+        now = current[field]
+        tol = PER_FIELD_TOLERANCE.get(field, args.tolerance)
+        if field in LOWER_BETTER:
+            bad = baseline > 0 and now > baseline * (1.0 + tol)
+            delta = (now - baseline) / baseline if baseline else 0.0
+        else:
+            bad = baseline > 0 and now < baseline * (1.0 - tol)
+            delta = (now - baseline) / baseline if baseline else 0.0
+        if bad:
+            regressions.append(
+                f"  {field}: {now:g} vs best {baseline:g} ({source}) "
+                f"— {delta:+.1%}, tolerance ±{tol:.0%}"
+            )
+        elif (delta > 0) != (field in LOWER_BETTER):
+            improved += 1
+
+    label = os.path.basename(current_path)
+    if regressions:
+        print(f"check_bench_regression: {len(regressions)} regression(s) "
+              f"in {label} vs best of {len(priors)} prior artifact(s):")
+        for line in regressions:
+            print(line)
+        if args.advisory:
+            print("ADVISORY mode: exit 0 (CPU-shape numbers measure the "
+                  "runner, not the code)")
+            return 0
+        return 1
+    print(
+        f"check_bench_regression: OK — {label}: {judged} field(s) judged, "
+        f"{improved} improved, 0 regressed "
+        f"(vs best of {len(priors)} prior artifact(s))"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,19 +1,25 @@
 #!/usr/bin/env python3
-"""swarmtop — live terminal dashboard for an agent-tpu fleet (ISSUE 8).
+"""swarmtop — live terminal dashboard for an agent-tpu fleet (ISSUE 8/9).
 
 Renders fleet state from ``GET /v1/health`` + ``/v1/status`` +
-``/v1/metrics`` the way ``top`` renders a host: a verdict banner, per-SLO
-attainment/burn/budget rows, queue pressure by tier, and one row per agent
-(liveness, rolling duty cycle, per-op MFU, staged queue depth, task
-throughput from the scrape delta between frames).
+``/v1/timeseries`` the way ``top`` renders a host: a verdict banner, per-SLO
+attainment/burn/budget rows, queue pressure by tier, one row per agent
+(liveness, rolling duty cycle, per-op MFU, staged queue depth), and trend
+sparklines (tasks/s, rows/s, queue depth, duty cycle) fed by the
+controller's time-series ring — rates come from the controller's own
+sampling clock, not from client-side scrape deltas, so the first frame
+already has history (``/v1/metrics`` scrape deltas remain the fallback
+against controllers predating the ring).
 
     python scripts/swarmtop.py --url http://controller:8080
     python scripts/swarmtop.py --url ... --once        # one frame (CI/cron)
+    python scripts/swarmtop.py --url ... --json        # one JSON doc (scripting)
     python scripts/swarmtop.py --url ... --interval 5  # refresh cadence
 
 Dependency-free by the obs charter: stdlib urllib + ANSI escapes only.
-``--once`` / ``--no-color`` make it pipeline-safe; exit code 2 when the
-controller is unreachable (so a watchdog cron can alert on it), else 0.
+``--once`` / ``--json`` / ``--no-color`` make it pipeline-safe; exit code 2
+when the controller is unreachable (so a watchdog cron can alert on it),
+else 0.
 """
 
 from __future__ import annotations
@@ -87,7 +93,8 @@ class Colors:
 
 def tasks_total(metrics_text) -> float:
     """Fleet-wide completed tasks off the exposition (unlabeled merge only —
-    ``agent``-labeled duplicates would double-count)."""
+    ``agent``-labeled duplicates would double-count). The scrape-delta
+    FALLBACK rate source for controllers without a time-series ring."""
     if not metrics_text:
         return 0.0
     try:
@@ -100,7 +107,62 @@ def tasks_total(metrics_text) -> float:
     )
 
 
-def render(health, status, rate, colors: Colors) -> str:
+# ---- time-series trends (ISSUE 9: rates from the controller's ring) ----
+
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def spark(values, width: int = 12) -> str:
+    """Unicode sparkline of the last ``width`` values (empty-safe)."""
+    vals = [v for v in values if isinstance(v, (int, float))][-width:]
+    if not vals:
+        return "-" * width
+    hi = max(vals)
+    if hi <= 0:
+        return SPARK_BLOCKS[0] * len(vals)
+    return "".join(
+        SPARK_BLOCKS[min(len(SPARK_BLOCKS) - 1,
+                         int(v / hi * (len(SPARK_BLOCKS) - 1) + 0.5))]
+        for v in vals
+    )
+
+
+def fetch_series(base: str, name: str, rate: bool = False, **labels):
+    """``GET /v1/timeseries`` → summed-across-series ``[(ts, value), ...]``
+    (label sets collapse — swarmtop trends the fleet line), or None when
+    the endpoint is absent/disabled (pre-ring controller)."""
+    q = f"name={name}" + ("&rate=1" if rate else "")
+    for k, v in labels.items():
+        q += f"&{k}={v}"
+    body = fetch_json(base + "/v1/timeseries?" + q)
+    if not isinstance(body, dict) or not body.get("enabled", True):
+        return None
+    acc = {}
+    for s in body.get("series", []):
+        for t, v in s.get("points", []):
+            acc[t] = acc.get(t, 0.0) + v
+    return sorted(acc.items())
+
+
+def collect_trends(base: str):
+    """The sparkline feed: tasks/s + rows/s rates, queue depth and duty
+    cycle levels. Each value is ``[(ts, v), ...]`` or None when the ring
+    doesn't carry the family (yet)."""
+    return {
+        "tasks_per_sec": fetch_series(base, "tasks_total", rate=True),
+        "rows_per_sec": fetch_series(base, "usage_rows_total", rate=True),
+        "queue_depth": fetch_series(
+            base, "controller_queue_depth", state="leasable"
+        ),
+        "duty_cycle": fetch_series(base, "device_duty_cycle"),
+    }
+
+
+def last_value(points):
+    return points[-1][1] if points else None
+
+
+def render(health, status, rate, colors: Colors, trends=None) -> str:
     lines = []
     verdict = health.get("verdict", "?")
     now = time.strftime("%H:%M:%S")
@@ -145,6 +207,26 @@ def render(health, status, rate, colors: Colors) -> str:
     else:
         lines.append(colors.paint("  (no objectives configured)", DIM))
     lines.append("")
+
+    if trends and any(trends.values()):
+        # Sparkline columns off the controller's time-series ring (ISSUE 9):
+        # history exists from frame one, no client-side delta bookkeeping.
+        lines.append(colors.paint("Trends", BOLD))
+        rows = (
+            ("tasks/s", trends.get("tasks_per_sec"), 1, ""),
+            ("rows/s", trends.get("rows_per_sec"), 0, ""),
+            ("queue", trends.get("queue_depth"), 0, ""),
+            ("duty", trends.get("duty_cycle"), 2, "x"),
+        )
+        for label, points, digits, unit in rows:
+            if not points:
+                continue
+            vals = [v for _t, v in points]
+            lines.append(
+                f"  {label:<9}{spark(vals)}  "
+                f"{fmt_num(vals[-1], digits)}{unit}"
+            )
+        lines.append("")
 
     q = health.get("queue", {})
     tiers = ", ".join(
@@ -217,11 +299,15 @@ def main() -> int:
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--once", action="store_true",
                     help="render one frame and exit (CI / cron)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON document "
+                         "(health + status + usage + trend series) and "
+                         "exit — the scripting mode")
     ap.add_argument("--no-color", action="store_true")
     args = ap.parse_args()
     base = args.url.rstrip("/")
     colors = Colors(
-        enabled=not args.no_color
+        enabled=not args.no_color and not args.json
         and (sys.stdout.isatty() or os.environ.get("FORCE_COLOR"))
     )
 
@@ -232,18 +318,40 @@ def main() -> int:
         if health is None:
             print(f"swarmtop: controller unreachable at {base}",
                   file=sys.stderr)
-            if args.once:
+            if args.once or args.json:
                 return 2
             time.sleep(args.interval)
             continue
         status = fetch_json(base + "/v1/status")
-        total = tasks_total(fetch_text(base + "/v1/metrics"))
-        now = time.monotonic()
-        rate = None
-        if prev_tasks is not None and now > prev_t:
-            rate = max(0.0, (total - prev_tasks) / (now - prev_t))
-        prev_tasks, prev_t = total, now
-        frame = render(health, status, rate, colors)
+        trends = collect_trends(base)
+        if args.json:
+            # One-shot scripting mode (ISSUE 9 satellite): everything the
+            # dashboard renders, as one JSON doc on stdout.
+            doc = {
+                "generated_at": time.time(),
+                "url": base,
+                "health": health,
+                "status": status,
+                "usage": fetch_json(base + "/v1/usage"),
+                "trends": trends,
+                "rates": {
+                    "tasks_per_sec": last_value(trends["tasks_per_sec"]),
+                    "rows_per_sec": last_value(trends["rows_per_sec"]),
+                },
+            }
+            json.dump(doc, sys.stdout, sort_keys=True)
+            sys.stdout.write("\n")
+            return 0
+        # Rate from the controller's ring; scrape-delta only as the
+        # fallback against pre-ring controllers.
+        rate = last_value(trends.get("tasks_per_sec"))
+        if rate is None:
+            total = tasks_total(fetch_text(base + "/v1/metrics"))
+            now = time.monotonic()
+            if prev_tasks is not None and now > prev_t:
+                rate = max(0.0, (total - prev_tasks) / (now - prev_t))
+            prev_tasks, prev_t = total, now
+        frame = render(health, status, rate, colors, trends=trends)
         if args.once:
             sys.stdout.write(frame)
             return 0
