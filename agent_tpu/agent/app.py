@@ -128,6 +128,14 @@ class Agent:
         self.session = session
         self.runtime = runtime
         self.running = True
+        # Graceful retirement (ISSUE 10): set by request_drain (SIGTERM,
+        # autoscaler scale-down, spot reclaim). A draining agent stops
+        # leasing new work, finishes the in-flight task, RELEASES the
+        # unstarted remainder of its lease (status="released" — instant
+        # requeue, no TTL wait, no attempt burned), flushes its spool and
+        # final metrics (the flush poll carries draining=true so
+        # /v1/status marks it), then exits clean.
+        self.draining = False
         self.rate = RateLimiter(self.config.agent.error_log_every_sec)
         # Observability (ISSUE 2): an OWN registry/recorder per agent — the
         # controller often shares the process (tests, bench) and the fleet
@@ -504,24 +512,27 @@ class Agent:
             captures = self._drain_capture_results()
             if captures:
                 metrics["profile_captures"] = captures
-            status, _ = self._post_json(
-                "/v1/leases",
-                {
-                    "agent": a.agent_name,
-                    # queue_depth sampled at request-BUILD time (ISSUE 6
-                    # satellite): the flush postdates the last real poll, so
-                    # without this the advertised depth would lag reality by
-                    # a whole poll cycle on every channel but the lease.
-                    "capabilities": {
-                        "ops": [],
-                        "queue_depth": self._staged_depth(),
-                    },
-                    "max_tasks": 0,
-                    "labels": a.labels,
-                    "metrics": metrics,
+            body: Dict[str, Any] = {
+                "agent": a.agent_name,
+                # queue_depth sampled at request-BUILD time (ISSUE 6
+                # satellite): the flush postdates the last real poll, so
+                # without this the advertised depth would lag reality by
+                # a whole poll cycle on every channel but the lease.
+                "capabilities": {
+                    "ops": [],
+                    "queue_depth": self._staged_depth(),
                 },
-                session=session,
-            )
+                "max_tasks": 0,
+                "labels": a.labels,
+                "metrics": metrics,
+            }
+            if self.draining:
+                # Drain handshake (ISSUE 10): the final flush is what tells
+                # the controller this member is retiring — /v1/status and
+                # /v1/health mark it `draining`. Absent otherwise, keeping
+                # the steady-state wire byte-identical.
+                body["draining"] = True
+            status, _ = self._post_json("/v1/leases", body, session=session)
             if status not in (200, 204):
                 if spans:
                     self.tracer.requeue(spans)
@@ -752,6 +763,39 @@ class Agent:
             )
         self.m_spool_depth.set(len(self.spool))
         return False
+
+    def release_job(
+        self, lease_id: str, job_id: str, job_epoch: Any, op: str = "?",
+        session: Any = None,
+    ) -> bool:
+        """Hand one unstarted leased task back to the controller (the drain
+        protocol, ISSUE 10): a ``status="released"`` result makes the job
+        instantly leasable again at a bumped epoch without burning the
+        attempt — scale-down never strands a lease waiting out the TTL.
+        A failed post spools and redelivers like any result; if the TTL
+        beats the redelivery the epoch fence discards it harmlessly."""
+        self.m_tasks.inc(op=op, status="released")
+        self.recorder.record(
+            "task_released", job_id=job_id, op=op, lease_id=lease_id,
+        )
+        return self.post_result(
+            lease_id, job_id, job_epoch, "released", op=op, session=session,
+        )
+
+    def release_task(
+        self, lease_id: str, task: Any, session: Any = None
+    ) -> bool:
+        """:meth:`release_job` from a raw task dict (no payload decode —
+        a release needs only the identity triple)."""
+        if not isinstance(task, dict):
+            return False
+        job_id = task.get("id", task.get("job_id"))
+        if not isinstance(job_id, str) or not job_id:
+            return False
+        op = task.get("op") if isinstance(task.get("op"), str) else "?"
+        return self.release_job(
+            lease_id, job_id, task.get("job_epoch"), op=op, session=session,
+        )
 
     def flush_spool(self, session: Any = None, force: bool = False) -> int:
         """Redeliver spooled results, oldest first, honoring the backoff
@@ -1173,9 +1217,14 @@ class Agent:
             return False
         lease_id, tasks = leased
         for task in tasks:
-            if not self.running:
-                break
-            self.run_task(lease_id, task)
+            if self.running:
+                self.run_task(lease_id, task)
+            elif self.draining:
+                # Drain (ISSUE 10): the in-flight task above finished and
+                # posted; the unstarted remainder of the lease is handed
+                # back instead of abandoned to the TTL.
+                self.release_task(lease_id, task)
+            # else: hard stop — abandoned, the lease TTL re-queues.
         return True
 
     # ---- multi-host (leader/follower, SURVEY.md §5.8) ----
@@ -1293,11 +1342,22 @@ class Agent:
 
             broadcast_shutdown()
 
-    def shutdown(self, *_args: Any) -> None:
-        """Signal handler: finish the in-flight task, then exit the loop
-        (reference ``app.py:239-249``)."""
+    def request_drain(self, reason: str = "drain") -> None:
+        """Begin graceful retirement (ISSUE 10) — the ONE drain path shared
+        by the SIGTERM handler, autoscaler scale-down, and spot reclaims:
+        stop leasing, finish the in-flight task, release the unstarted
+        remainder of the lease, flush spool + final metrics (tagged
+        ``draining``), exit clean."""
+        if not self.draining:
+            self.draining = True
+            log("drain requested", reason=reason)
         self.running = False
-        log("shutdown requested — draining")
+
+    def shutdown(self, *_args: Any) -> None:
+        """Signal handler (SIGINT/SIGTERM): the drain path — a SIGTERM from
+        ``Fleet.stop`` or a spot reclaim retires exactly like an autoscaler
+        scale-down (reference ``app.py:239-249`` only stopped the loop)."""
+        self.request_drain(reason="signal")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

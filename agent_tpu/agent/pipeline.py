@@ -511,9 +511,16 @@ class PipelineRunner:
         finally:
             self.agent.running = False
             self._pool.join(timeout=30)
+            # Graceful drain (ISSUE 10): tasks still queued for staging
+            # after the workers exited are handed back (released) instead
+            # of stranding the lease until the TTL; release_pending no-ops
+            # unless the agent is draining.
+            self._pool.release_pending()
             self._poster.join(timeout=30)
             # Final telemetry flush (metrics-only lease): the last shard's
             # finalize postdates the stager's last real poll, so without
-            # this the fleet view would miss the drain's tail.
+            # this the fleet view would miss the drain's tail. A draining
+            # agent's flush carries the `draining` mark — the controller
+            # half of the drain handshake.
             self.agent.push_metrics()
         log("pipelined drain stopped", tasks_posted=self.tasks_posted)

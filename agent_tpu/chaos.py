@@ -53,7 +53,13 @@ class FaultPlan:
     ``http_500``, ``duplicate_result``, ``delay`` (+ ``delay_max_sec``).
     Controller-side kinds (``Controller.inject(plan=...)``): ``drop_lease``,
     ``duplicate_task``, ``stale_epoch``. Harness-level: ``agent_crash``
-    (the soak abandons a granted lease and restarts the agent).
+    (the soak abandons a granted lease and restarts the agent), plus the
+    preemption kinds (ISSUE 10): ``spot_reclaim`` — SIGTERM with a grace
+    window, the member runs the full drain path (finish/release the
+    in-flight lease, flush spool + final metrics, exit clean) before the
+    capacity disappears — and ``hard_kill`` — SIGKILL mid-execute, no
+    drain: in-flight work is lost and must be recovered by lease-TTL
+    expiry + epoch fencing while the autoscaler replaces the capacity.
     """
 
     seed: int = 0
@@ -70,6 +76,9 @@ class FaultPlan:
     stale_epoch: float = 0.0
     # harness-level faults
     agent_crash: float = 0.0
+    # preemption faults (ISSUE 10): decided per live member per churn tick
+    spot_reclaim: float = 0.0
+    hard_kill: float = 0.0
     counts: Dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:
@@ -142,6 +151,9 @@ class LoopbackSession:
                 metrics=body.get("metrics"),
                 labels=body.get("labels")
                 if isinstance(body.get("labels"), dict) else None,
+                # Drain handshake (ISSUE 10): a retiring agent's final
+                # metrics-only poll marks it `draining` in /v1/status.
+                draining=bool(body.get("draining")),
             )
             return (
                 _FakeResponse(204) if out is None else _FakeResponse(200, out)

@@ -252,8 +252,8 @@ class Controller:
             "controller_tasks_leased_total", "Tasks handed out", ("op",))
         self._m_results = m.counter(
             "controller_results_total",
-            "Result posts by op and outcome (succeeded/failed/stale_epoch/"
-            "duplicate/unknown_job)", ("op", "outcome"))
+            "Result posts by op and outcome (succeeded/failed/released/"
+            "stale_epoch/duplicate/unknown_job)", ("op", "outcome"))
         self._m_retries = m.counter(
             "controller_retries_total",
             "Transiently-failed jobs re-queued within their retry budget",
@@ -390,6 +390,13 @@ class Controller:
         # Job ids some other job depends on (reduce stages): their result
         # bodies must survive a restart, so only these journal results.
         self._depended_on: Set[str] = set()
+        # Journal replay damage, distinctly visible to operators (ISSUE 10
+        # satellite): a torn FINAL line (expected crash artifact, tolerated)
+        # vs unparseable MID-FILE lines (real corruption). Mirrored from the
+        # replay-time counters into /v1/status so "did my journal replay
+        # clean" reads off one status call, not a metrics scrape.
+        self.journal_torn_tail = 0
+        self.journal_replay_skipped = 0
         self._journal_file = None
         if journal_path:
             self._replay_journal(journal_path)
@@ -523,6 +530,7 @@ class Controller:
                 a: {
                     "last_seen_wall": e.get("last_seen_wall", 0.0),
                     "obs": e.get("obs"),
+                    "draining": bool(e.get("draining")),
                 }
                 for a, e in self.agent_metrics.items()
             }
@@ -600,6 +608,7 @@ class Controller:
                     # satellite): a counted warning distinguishes "the
                     # controller died mid-append" from a pristine journal.
                     self._m_journal_torn.inc()
+                    self.journal_torn_tail += 1
                     log(
                         "journal replay tolerated a torn final line",
                         path=path, line=i + 1,
@@ -661,6 +670,7 @@ class Controller:
                 if job is not None:
                     job.epoch = int(ev.get("epoch", job.epoch))
         if skipped:
+            self.journal_replay_skipped += len(skipped)
             self._m_journal_skipped.inc(len(skipped))
             log(
                 "journal replay skipped unparseable mid-file lines",
@@ -1201,13 +1211,14 @@ class Controller:
         worker_profile: Optional[Dict[str, Any]] = None,
         metrics: Optional[Dict[str, Any]] = None,
         labels: Optional[Dict[str, Any]] = None,
+        draining: bool = False,
         **_ignored: Any,
     ) -> Optional[Dict[str, Any]]:
         try:
             return self._lease_impl(
                 agent, capabilities=capabilities, max_tasks=max_tasks,
                 worker_profile=worker_profile, metrics=metrics,
-                labels=labels, **_ignored,
+                labels=labels, draining=draining, **_ignored,
             )
         finally:
             # Trend-ring backstop (ISSUE 9): AFTER the lease, so the sample
@@ -1225,9 +1236,18 @@ class Controller:
         worker_profile: Optional[Dict[str, Any]] = None,
         metrics: Optional[Dict[str, Any]] = None,
         labels: Optional[Dict[str, Any]] = None,
+        draining: bool = False,
         **_ignored: Any,
     ) -> Optional[Dict[str, Any]]:
         """One lease request → ``{lease_id, tasks}`` or None (HTTP 204).
+
+        ``draining=True`` (ISSUE 10) marks the agent as retiring in the
+        per-agent view — ``/v1/status`` and ``/v1/health`` surface it, and
+        the autoscaler stops counting the member as live capacity. The mark
+        clears when the same agent name polls again without the flag (a
+        fresh incarnation after a reclaim). Placement needs no change: a
+        draining agent never asks for work, and the pull protocol is the
+        fence.
 
         ``max_tasks < 1`` is a **metrics-only poll**: the agent's telemetry
         is recorded (per-agent snapshot, profile) but nothing leases — the
@@ -1292,6 +1312,21 @@ class Controller:
                     }
             elif agent and agent in self.agent_metrics:
                 self.agent_metrics[agent]["last_seen_wall"] = now_wall
+            if agent:
+                # Drain handshake: sticky until a NON-draining poll from the
+                # same name (a restarted incarnation) clears it.
+                entry = self.agent_metrics.get(agent)
+                if entry is not None:
+                    entry["draining"] = bool(draining)
+                elif draining:
+                    self.agent_metrics[agent] = {
+                        "last_seen_wall": now_wall,
+                        "metrics": {},
+                        "obs": None,
+                        "draining": True,
+                    }
+                if draining:
+                    self.recorder.record("agent_draining", agent=agent)
             if worker_profile:
                 self.last_profile = worker_profile
                 tpu = worker_profile.get("tpu") or {}
@@ -1539,6 +1574,45 @@ class Controller:
                     reason="already complete", lease_id=lease_id,
                 )
                 return {"accepted": False, "reason": "already complete"}
+            if status == "released":
+                # Drain handback (ISSUE 10): a retiring agent returns an
+                # unstarted leased task. Requeue NOW (no TTL wait), bump the
+                # epoch (any late duplicate of this lease is fenced), and
+                # give the attempt back — a release is not a failure and
+                # must not eat the retry budget. The epoch check above
+                # already proved this lease still owns the job.
+                if job.state != LEASED:
+                    self._m_results.inc(op=job.op, outcome="duplicate")
+                    self.recorder.record(
+                        "result_rejected", job_id=job_id, op=job.op,
+                        reason="release of unleased job", lease_id=lease_id,
+                    )
+                    return {"accepted": False, "reason": "not leased"}
+                now = self._clock()
+                job.epoch += 1
+                job.state = PENDING
+                job.lease_id = None
+                job.attempts = max(0, job.attempts - 1)
+                job.not_before = now
+                job.enqueued_clock = now
+                self.traces.finish(
+                    job.job_id, job.lease_span_id, now,
+                    attributes={"outcome": "released"},
+                )
+                job.lease_span_id = None
+                self._sched.add(job)
+                self._m_results.inc(op=job.op, outcome="released")
+                self.recorder.record(
+                    "released", job_id=job_id, op=job.op, epoch=job.epoch,
+                    lease_id=lease_id, agent=job.agent,
+                )
+                self._update_queue_stats_locked(now)
+                # Journaled like an expiry requeue: replay must keep the
+                # fence or a post-restart duplicate could apply.
+                self._journal(
+                    {"ev": "requeue", "job_id": job_id, "epoch": job.epoch}
+                )
+                return {"accepted": True, "released": True}
             # result/error before state: unlocked readers keying on a
             # terminal state must never see it paired with a stale result.
             t_apply = self._clock()
@@ -1705,6 +1779,18 @@ class Controller:
                 out[job.state] = out.get(job.state, 0) + 1
             return out
 
+    def leased_to(self, agent: str) -> List[str]:
+        """Job ids currently leased to ``agent`` — the scale-down
+        stranded-lease probe (ISSUE 10): the moment a graceful retirement
+        completes this must be empty, because the drain finished the
+        in-flight task and released the rest instead of abandoning them to
+        the TTL."""
+        with self._lock:
+            return [
+                j.job_id for j in self._jobs.values()
+                if j.state == LEASED and j.agent == agent
+            ]
+
     def drained(self) -> bool:
         with self._lock:
             return all(
@@ -1741,15 +1827,20 @@ class Controller:
         now = time.time()
         with self._lock:
             entries = {
-                a: (e.get("last_seen_wall", 0.0), e.get("metrics") or {})
+                a: (
+                    e.get("last_seen_wall", 0.0),
+                    bool(e.get("draining")),
+                    e.get("metrics") or {},
+                )
                 for a, e in self.agent_metrics.items()
             }
         return {
             a: {
                 "last_seen_sec_ago": round(max(0.0, now - seen), 3),
+                "draining": drain,
                 "metrics": m,
             }
-            for a, (seen, m) in entries.items()
+            for a, (seen, drain, m) in entries.items()
         }
 
     def fleet_snapshot(self) -> Dict[str, Any]:

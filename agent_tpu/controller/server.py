@@ -79,6 +79,9 @@ class _Handler(BaseHTTPRequestHandler):
                 labels=body.get("labels")
                 if isinstance(body.get("labels"), dict)
                 else None,
+                # Drain handshake (ISSUE 10): a retiring agent's final
+                # flush carries draining=true; /v1/status marks it.
+                draining=bool(body.get("draining")),
             )
             if lease is None:
                 n_out = self._send(204)
@@ -330,6 +333,14 @@ class _Handler(BaseHTTPRequestHandler):
                     "stale_results": self.controller.stale_results,
                     "agents": self.controller.agents_summary(),
                     "summary": self.controller.status_summary(),
+                    # Journal replay damage, operator-visible (ISSUE 10
+                    # satellite): torn FINAL line (tolerated crash artifact)
+                    # counted distinctly from mid-file corruption.
+                    "journal": {
+                        "torn_tail": self.controller.journal_torn_tail,
+                        "replay_skipped":
+                            self.controller.journal_replay_skipped,
+                    },
                     "last_metrics": self.controller.last_metrics,
                 },
             )

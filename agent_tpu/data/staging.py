@@ -248,9 +248,12 @@ class StagingPool:
                     continue
                 lease_id, tasks = leased
                 for task in tasks:
-                    if not agent.running:
-                        break
-                    self._put_task((lease_id, task))
+                    if agent.running:
+                        self._put_task((lease_id, task))
+                    elif getattr(agent, "draining", False):
+                        # Drain (ISSUE 10): hand unstarted tasks back
+                        # instead of abandoning them to the lease TTL.
+                        agent.release_task(lease_id, task)
         finally:
             # One sentinel per worker, delivered even if the feeder died
             # unexpectedly; the last worker converts them into the device
@@ -265,13 +268,44 @@ class StagingPool:
                 return
             except queue.Full:
                 if not self.agent.running and not force:
-                    return  # drain aborted; lease TTL re-queues the task
+                    self._release_entry(entry)
+                    return  # drain aborted; released, or TTL re-queues
                 if force and self._workers_alive_count() == 0:
                     return  # nobody left to read the sentinel
 
     def _workers_alive_count(self) -> int:
         with self._alive_lock:
             return self._workers_alive
+
+    def _release_entry(self, entry: Any) -> None:
+        """Hand a dropped ``(lease_id, task)`` back during a graceful drain
+        (ISSUE 10) — without this every drop point strands the lease until
+        the TTL. A non-draining stop keeps the historical abandon."""
+        if entry is self.stop_token or not getattr(
+            self.agent, "draining", False
+        ):
+            return
+        try:
+            lease_id, task = entry
+        except (TypeError, ValueError):
+            return
+        self.agent.release_task(lease_id, task)
+
+    def release_pending(self) -> int:
+        """Drain-release every task still queued for staging after the
+        workers exited (a worker that parked at the gate during shutdown
+        leaves its queue tail unread). Called by the runner once the pool
+        has joined; returns how many were handed back."""
+        released = 0
+        while True:
+            try:
+                entry = self.task_q.get_nowait()
+            except queue.Empty:
+                return released
+            if entry is self.stop_token:
+                continue
+            self._release_entry(entry)
+            released += 1
 
     # ---- worker threads ----
 
@@ -291,9 +325,14 @@ class StagingPool:
                 # The autotuner's lever: workers above the gate limit park
                 # here instead of staging, shedding parallelism without
                 # tearing threads down.
+                dropped = False
                 while not self.gate.acquire(timeout=0.5):
                     if not agent.running:
-                        return  # dropped task re-queues via lease TTL
+                        self._release_entry(entry)
+                        dropped = True  # released, or TTL re-queues
+                        break
+                if dropped:
+                    return
                 try:
                     item = self.stage_fn(lease_id, task)
                 finally:
@@ -321,7 +360,14 @@ class StagingPool:
                 return
             except queue.Full:
                 if not self.agent.running:
-                    return  # drain aborted; lease TTL re-queues the task
+                    if getattr(self.agent, "draining", False):
+                        # Staged but never executed: nothing applied, so a
+                        # release is correct — the work re-runs elsewhere.
+                        self.agent.release_job(
+                            item.lease_id, item.job_id, item.epoch,
+                            op=item.op,
+                        )
+                    return  # drain aborted; released, or TTL re-queues
 
     # ---- autotuner ----
 

@@ -164,6 +164,9 @@ def agent_health(
     mfu = _series_by_label(snap, "device_mfu", "op")
     out: Dict[str, Any] = {
         "last_seen_sec_ago": round(max(0.0, now_wall - last_seen), 3),
+        # Retiring member (ISSUE 10): the autoscaler must not count it as
+        # live capacity, and operators see the drain in flight.
+        "draining": bool(entry.get("draining")),
         "duty_cycle": round(duty, 4) if duty is not None else None,
         "device_busy_s": round(busy, 3),
         "device_busy_s_by_op": {

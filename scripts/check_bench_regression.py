@@ -22,6 +22,13 @@ script closes the loop:
 where absolute numbers measure the runner, not the code (the ISSUE 9
 acceptance bar: CI-wired, advisory on CPU). Run it strict on real TPU
 hardware after a bench round.
+
+``--enforce-fields f1,f2`` (ISSUE 10 satellite) promotes the named fields
+to ENFORCING even under ``--advisory``: a regression in one of them exits 1
+regardless. CI judges committed artifacts (not a fresh run), so enforcing
+is deterministic — it fires only when a NEW BENCH_r* artifact lands in the
+repo with a regressed field, which is exactly the review moment it should
+block. Wired for the drain flat fields with multi-round history.
 """
 
 from __future__ import annotations
@@ -118,7 +125,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--advisory", action="store_true",
                     help="report regressions but exit 0 (the CI mode on "
                          "CPU shapes)")
+    ap.add_argument("--enforce-fields", default="",
+                    help="comma-separated fields judged ENFORCING even "
+                         "under --advisory (regressions there exit 1)")
     args = ap.parse_args(argv)
+    enforced = {
+        f.strip() for f in args.enforce_fields.split(",") if f.strip()
+    }
 
     rounds = sorted(
         (bench_round(p), p, load_flat_fields(p))
@@ -148,6 +161,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     regressions: List[str] = []
+    enforced_regressions: List[str] = []
     improved = judged = 0
     for field in sorted(current):
         base = best_prior(priors, field)
@@ -164,10 +178,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             bad = baseline > 0 and now < baseline * (1.0 - tol)
             delta = (now - baseline) / baseline if baseline else 0.0
         if bad:
-            regressions.append(
+            tag = " [ENFORCED]" if field in enforced else ""
+            line = (
                 f"  {field}: {now:g} vs best {baseline:g} ({source}) "
-                f"— {delta:+.1%}, tolerance ±{tol:.0%}"
+                f"— {delta:+.1%}, tolerance ±{tol:.0%}{tag}"
             )
+            regressions.append(line)
+            if field in enforced:
+                enforced_regressions.append(line)
         elif (delta > 0) != (field in LOWER_BETTER):
             improved += 1
 
@@ -178,6 +196,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         for line in regressions:
             print(line)
         if args.advisory:
+            if enforced_regressions:
+                print(
+                    f"{len(enforced_regressions)} regression(s) in "
+                    "ENFORCED fields: exit 1 despite advisory mode"
+                )
+                return 1
             print("ADVISORY mode: exit 0 (CPU-shape numbers measure the "
                   "runner, not the code)")
             return 0

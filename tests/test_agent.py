@@ -263,6 +263,10 @@ def test_extract_task_accepts_id_or_job_id_and_validates():
 
 
 def test_shutdown_drains_mid_lease():
+    """SIGTERM drain regression (ISSUE 10 satellite): the in-flight task's
+    result is DELIVERED, the unstarted remainder of the lease is RELEASED
+    (not abandoned to the TTL), the spool ends empty, and the final
+    metrics flush carries the `draining` mark."""
     lease = StubResponse(
         200,
         {
@@ -273,7 +277,12 @@ def test_shutdown_drains_mid_lease():
             ],
         },
     )
-    session = StubSession([lease, StubResponse(200, {})])
+    session = StubSession([
+        lease,
+        StubResponse(200, {"accepted": True}),   # j1 result
+        StubResponse(200, {"accepted": True, "released": True}),  # j2
+        StubResponse(204),                       # final metrics flush
+    ])
     agent = Agent(config=fast_config(max_tasks=2), session=session)
     agent._profile = {}
 
@@ -281,13 +290,76 @@ def test_shutdown_drains_mid_lease():
 
     def run_then_stop(lease_id, task):
         real_run(lease_id, task)
-        agent.shutdown()
+        agent.shutdown()  # the actual SIGTERM handler
 
     agent.run_task = run_then_stop
     agent.run(max_steps=5)
-    # Only the first task ran; second was dropped by the drain and will be
-    # re-leased by the controller after TTL.
+    # The in-flight task ran and its result was delivered.
     assert agent.tasks_done == 1
+    results = [
+        body for url, body in session.requests if url.endswith("/v1/results")
+    ]
+    assert [r["job_id"] for r in results] == ["j1", "j2"]
+    assert results[0]["status"] == "succeeded"
+    assert results[1]["status"] == "released"  # handed back, no TTL wait
+    # Nothing left undelivered, and the drain announced itself.
+    assert len(agent.spool) == 0
+    flush = session.requests[-1][1]
+    assert flush["max_tasks"] == 0 and flush["draining"] is True
+
+
+def test_hard_stop_without_drain_abandons_remainder():
+    """running=False WITHOUT request_drain (the hard-kill model) keeps the
+    historical behavior: the unstarted task is abandoned to the lease TTL,
+    no release is posted."""
+    lease = StubResponse(
+        200,
+        {
+            "lease_id": "L1",
+            "tasks": [
+                {"id": "j1", "op": "echo", "payload": {}, "job_epoch": 0},
+                {"id": "j2", "op": "echo", "payload": {}, "job_epoch": 0},
+            ],
+        },
+    )
+    session = StubSession([
+        lease,
+        StubResponse(200, {"accepted": True}),
+        StubResponse(204),  # final flush (no draining mark)
+    ])
+    agent = Agent(config=fast_config(max_tasks=2), session=session)
+    agent._profile = {}
+
+    real_run = agent.run_task
+
+    def run_then_kill(lease_id, task):
+        real_run(lease_id, task)
+        agent.running = False  # hard stop, not a drain
+
+    agent.run_task = run_then_kill
+    agent.run(max_steps=5)
+    results = [
+        body for url, body in session.requests if url.endswith("/v1/results")
+    ]
+    assert [r["job_id"] for r in results] == ["j1"]
+    assert "draining" not in session.requests[-1][1]
+
+
+def test_release_task_posts_released_status():
+    session = StubSession([StubResponse(200, {"accepted": True})])
+    agent = Agent(config=fast_config(), session=session)
+    agent._profile = {}
+    ok = agent.release_task(
+        "L9", {"id": "j7", "op": "echo", "job_epoch": 3}
+    )
+    assert ok
+    url, body = session.requests[0]
+    assert url.endswith("/v1/results")
+    assert body["status"] == "released" and body["job_id"] == "j7"
+    assert body["job_epoch"] == 3 and body["lease_id"] == "L9"
+    # Malformed tasks release nothing (nothing to address the release to).
+    assert agent.release_task("L9", {"op": "echo"}) is False
+    assert agent.release_task("L9", "not-a-dict") is False
 
 
 def test_host_metrics_shape():

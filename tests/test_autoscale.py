@@ -1,0 +1,342 @@
+"""Autoscaler control loop (ISSUE 10): signal projection, scale decisions
+with hysteresis/cooldown, capacity replacement, fleet drivers, and an
+end-to-end scale-up/scale-down round against a real controller."""
+
+import threading
+import time
+
+import pytest
+
+from agent_tpu.autoscale import (
+    DOWN,
+    HOLD,
+    REPLACE,
+    UP,
+    Autoscaler,
+    FleetDriver,
+    Signals,
+    ThreadFleetDriver,
+    read_signals,
+)
+from agent_tpu.config import AutoscaleConfig
+
+
+class FakeDriver(FleetDriver):
+    def __init__(self, size=1):
+        self._size = size
+        self.spawned = 0
+        self.retired = 0
+
+    def size(self):
+        return self._size
+
+    def spawn(self, n):
+        self._size += n
+        self.spawned += n
+        return [f"m-{i}" for i in range(n)]
+
+    def retire(self, n):
+        n = min(n, self._size)
+        self._size -= n
+        self.retired += n
+        return [f"m-{i}" for i in range(n)]
+
+
+def make_scaler(driver, **cfg_kw):
+    cfg_kw.setdefault("min_agents", 1)
+    cfg_kw.setdefault("max_agents", 4)
+    cfg_kw.setdefault("up_queue_per_agent", 4.0)
+    cfg_kw.setdefault("down_idle_evals", 2)
+    cfg_kw.setdefault("up_cooldown_sec", 10.0)
+    cfg_kw.setdefault("down_cooldown_sec", 10.0)
+    clock = {"t": 100.0}
+    scaler = Autoscaler(
+        driver, lambda: None, config=AutoscaleConfig(**cfg_kw),
+        clock=lambda: clock["t"],
+    )
+    return scaler, clock
+
+
+class TestReadSignals:
+    def test_unreachable_health_is_unhealthy(self):
+        assert read_signals(None).healthy is False
+        assert read_signals("nope").healthy is False
+
+    def test_projects_queue_slo_and_agents(self):
+        sig = read_signals({
+            "verdict": "warn",
+            "queue": {"depth": 7, "starvation_age_sec": 3.5},
+            "slo": {"enabled": True, "objectives": [
+                {"objective": "tier8", "state": "warn"},
+            ]},
+            "counts": {"pending": 5, "leased": 2, "succeeded": 9},
+            "agents": {
+                "a": {"duty_cycle": 0.9, "stale": False, "draining": False},
+                "b": {"duty_cycle": 0.1, "stale": False, "draining": True},
+                "c": {"duty_cycle": None, "stale": True, "draining": False},
+            },
+        })
+        assert sig.healthy and sig.slo_burning
+        assert sig.queue_depth == 7
+        assert sig.starvation_age_sec == 3.5
+        assert sig.live_agents == 1          # draining + stale excluded
+        assert sig.draining_agents == 1
+        assert sig.max_duty == 0.9
+        assert sig.active_jobs == 7
+
+
+class TestDecide:
+    def test_scale_up_on_queue_pressure(self):
+        scaler, _ = make_scaler(FakeDriver(size=2), step_up=2)
+        d = scaler.decide(Signals(queue_depth=20, active_jobs=20))
+        assert d.action == UP and d.n == 2 and d.reason == "queue_pressure"
+
+    def test_scale_up_on_slo_burn_and_starvation(self):
+        scaler, _ = make_scaler(FakeDriver(size=2))
+        d = scaler.decide(
+            Signals(queue_depth=1, active_jobs=1, slo_burning=True)
+        )
+        assert d.action == UP and d.reason == "slo_burn"
+        scaler2, _ = make_scaler(FakeDriver(size=2), up_starvation_sec=5.0)
+        d = scaler2.decide(
+            Signals(queue_depth=1, active_jobs=1, starvation_age_sec=9.0)
+        )
+        assert d.action == UP and d.reason == "starvation"
+
+    def test_up_clamped_at_max_and_cooldown(self):
+        driver = FakeDriver(size=4)
+        scaler, clock = make_scaler(driver, max_agents=4)
+        d = scaler.decide(Signals(queue_depth=100, active_jobs=100))
+        assert d.action == HOLD and d.reason == "at_max"
+        driver = FakeDriver(size=2)
+        scaler, clock = make_scaler(driver, max_agents=6, step_up=2)
+        scaler.apply(scaler.decide(Signals(queue_depth=100,
+                                           active_jobs=100)))
+        assert driver.spawned == 2
+        # Immediately wanting more: blocked by the up cooldown.
+        d = scaler.decide(Signals(queue_depth=100, active_jobs=100))
+        assert d.action == HOLD and d.reason == "up_cooldown"
+        clock["t"] += 60.0
+        d = scaler.decide(Signals(queue_depth=100, active_jobs=100))
+        assert d.action == UP  # cooldown elapsed, room below max
+
+    def test_scale_down_needs_consecutive_idle_evals(self):
+        scaler, clock = make_scaler(FakeDriver(size=3), down_idle_evals=3)
+        idle = Signals(queue_depth=0, active_jobs=0, max_duty=0.0)
+        assert scaler.decide(idle).action == HOLD
+        assert scaler.decide(idle).action == HOLD
+        d = scaler.decide(idle)
+        assert d.action == DOWN and d.n == 1 and d.reason == "idle"
+
+    def test_busy_signal_resets_the_idle_streak(self):
+        scaler, _ = make_scaler(FakeDriver(size=3), down_idle_evals=2)
+        idle = Signals(queue_depth=0, active_jobs=0, max_duty=0.0)
+        busy = Signals(queue_depth=1, active_jobs=1)
+        assert scaler.decide(idle).action == HOLD
+        assert scaler.decide(busy).reason == "busy"
+        assert scaler.decide(idle).action == HOLD  # streak restarted
+        assert scaler.decide(idle).action == DOWN
+
+    def test_duty_gate_blocks_scale_down(self):
+        scaler, _ = make_scaler(
+            FakeDriver(size=3), down_idle_evals=1, down_max_duty=0.2
+        )
+        hot = Signals(queue_depth=0, active_jobs=0, max_duty=0.5)
+        assert scaler.decide(hot).reason == "busy"
+        cold = Signals(queue_depth=0, active_jobs=0, max_duty=0.1)
+        assert scaler.decide(cold).action == DOWN
+
+    def test_down_respects_floor_and_cooldown(self):
+        driver = FakeDriver(size=1)
+        scaler, clock = make_scaler(driver, min_agents=1, down_idle_evals=1)
+        idle = Signals(queue_depth=0, active_jobs=0, max_duty=0.0)
+        assert scaler.decide(idle).reason == "at_min"
+        driver._size = 3
+        scaler.apply(scaler.decide(idle))
+        assert driver.retired == 1
+        d = scaler.decide(idle)
+        assert d.action == HOLD and d.reason == "down_cooldown"
+        clock["t"] += 60.0
+        assert scaler.decide(idle).action == DOWN
+
+    def test_replacement_repairs_reclaimed_capacity(self):
+        driver = FakeDriver(size=3)
+        scaler, clock = make_scaler(driver, min_agents=1)
+        # Earn a desired size of 3 via a scale-up from 1.
+        driver._size = 1
+        scaler.apply(scaler.decide(Signals(queue_depth=50, active_jobs=50)))
+        assert scaler.desired == 3
+        # A reclaim drops actual below desired: repair bypasses cooldowns.
+        driver._size = 1
+        d = scaler.decide(Signals(queue_depth=0, active_jobs=0))
+        assert d.action == REPLACE and d.n == 2
+        assert d.reason == "capacity_lost"
+        scaler.apply(d)
+        assert driver.size() == 3
+        # Below the hard floor the reason names it.
+        driver._size = 0
+        scaler.desired = 1
+        d = scaler.decide(Signals(queue_depth=0, active_jobs=0))
+        assert d.action == REPLACE and d.reason == "below_min"
+
+    def test_unhealthy_controller_holds(self):
+        scaler, _ = make_scaler(FakeDriver(size=2))
+        d = scaler.decide(read_signals(None))
+        assert d.action == HOLD and d.reason == "health_unreachable"
+
+    def test_no_flap_under_oscillating_signal(self):
+        """A signal alternating busy/idle every evaluation must produce
+        ZERO scale events — the hysteresis contract."""
+        driver = FakeDriver(size=2)
+        scaler, clock = make_scaler(
+            driver, down_idle_evals=3, up_queue_per_agent=4.0
+        )
+        idle = Signals(queue_depth=0, active_jobs=0, max_duty=0.0)
+        mild = Signals(queue_depth=3, active_jobs=3)  # below up threshold
+        for i in range(50):
+            clock["t"] += 1.0
+            scaler.apply(scaler.decide(idle if i % 2 else mild))
+        assert driver.spawned == 0 and driver.retired == 0
+
+    def test_step_exports_fleet_size_and_decision_families(self):
+        driver = FakeDriver(size=2)
+        clock = {"t": 0.0}
+        scaler = Autoscaler(
+            driver,
+            lambda: {"verdict": "ok", "queue": {"depth": 0},
+                     "slo": {"objectives": []}, "counts": {}, "agents": {}},
+            config=AutoscaleConfig(min_agents=1, max_agents=4),
+            clock=lambda: clock["t"],
+        )
+        scaler.step()
+        snap = scaler.metrics.snapshot()
+        states = {
+            s["labels"]["state"]: s["value"]
+            for s in snap["fleet_size"]["series"]
+        }
+        assert states["actual"] == 2
+        assert snap["autoscale_decisions_total"]["series"]
+
+
+class TestThreadFleetDriver:
+    class _StubAgent:
+        def __init__(self, name):
+            self.name = name
+            self.running = True
+            self.draining = False
+            self.spool = []
+            self.session = None
+            self.drain_reasons = []
+
+        def run(self):
+            while self.running:
+                time.sleep(0.005)
+
+        def request_drain(self, reason="drain"):
+            self.draining = True
+            self.drain_reasons.append(reason)
+            self.running = False
+
+    def test_spawn_retire_lifecycle(self):
+        driver = ThreadFleetDriver(self._StubAgent, name_prefix="t")
+        names = driver.spawn(3)
+        assert len(names) == 3 and driver.size() == 3
+        retired = driver.retire(2)
+        assert len(retired) == 2 and driver.size() == 1
+        for entry in driver.retired:
+            assert entry["clean_exit"] and entry["spool_len"] == 0
+            assert entry["agent"].drain_reasons == ["autoscale_retire"]
+        # Retiring an unknown member is a no-op.
+        assert driver.retire_member("nope") is False
+
+    def test_kill_skips_the_drain_path(self):
+        driver = ThreadFleetDriver(self._StubAgent, name_prefix="t")
+        (name,) = driver.spawn(1)
+        agent = driver.agent(name)
+        assert driver.kill(name) is True
+        assert driver.size() == 0
+        assert agent.draining is False       # no drain path
+        assert driver.killed == [name]
+
+
+class TestEndToEnd:
+    def test_scales_up_under_load_and_down_at_idle(self):
+        """Real Controller + real Agents on threads + the real loop: queue
+        pressure grows the fleet, the idle tail shrinks it back, nothing is
+        lost, retired members drain clean."""
+        from agent_tpu.agent.app import Agent
+        from agent_tpu.chaos import LoopbackSession
+        from agent_tpu.config import AgentConfig, Config
+        from agent_tpu.controller.core import Controller
+
+        controller = Controller(
+            lease_ttl_sec=5.0, sweep_interval_sec=0.1,
+        )
+
+        class ThrottledSession:
+            """Loopback with a transport RTT: echo tasks alone drain too
+            fast for any control loop to observe queue pressure."""
+
+            def __init__(self, inner):
+                self.inner = inner
+
+            def post(self, url, json=None, timeout=None):
+                time.sleep(0.02)
+                return self.inner.post(url, json=json, timeout=timeout)
+
+        def factory(name):
+            cfg = Config(agent=AgentConfig(
+                controller_url="http://loopback", agent_name=name,
+                tasks=("echo",), max_tasks=1, idle_sleep_sec=0.01,
+                error_backoff_sec=0.01, pipeline_depth=0,
+            ))
+            agent = Agent(
+                config=cfg,
+                session=ThrottledSession(LoopbackSession(controller)),
+            )
+            agent._profile = {}
+            return agent
+
+        driver = ThreadFleetDriver(factory, name_prefix="e2e")
+        scaler = Autoscaler(
+            driver, controller.health_json,
+            config=AutoscaleConfig(
+                min_agents=1, max_agents=3, interval_sec=0.1,
+                up_queue_per_agent=2.0, down_idle_evals=2,
+                down_max_duty=1.0, up_cooldown_sec=0.3,
+                down_cooldown_sec=0.2,
+            ),
+            registry=controller.metrics,
+        )
+        stop = threading.Event()
+        thread = threading.Thread(
+            target=scaler.run, args=(stop,), kwargs={"interval_sec": 0.1},
+            daemon=True,
+        )
+        try:
+            driver.spawn(1)
+            thread.start()
+            for i in range(40):
+                controller.submit("echo", {"i": i})
+            deadline = time.monotonic() + 30.0
+            while not controller.drained() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert controller.drained()
+            assert scaler.scale_ups >= 1
+            # Idle tail shrinks back to the floor.
+            deadline = time.monotonic() + 15.0
+            while driver.size() > 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert driver.size() == 1
+            assert scaler.scale_downs >= 1
+        finally:
+            stop.set()
+            thread.join(timeout=5)
+            driver.retire(driver.size())
+            controller.close()
+        counts = controller.counts()
+        assert counts == {"succeeded": 40}
+        for entry in driver.retired:
+            assert entry["clean_exit"] and entry["spool_len"] == 0
+            # The drain announced itself to the controller.
+            assert controller.agents_summary()[entry["name"]]["draining"]

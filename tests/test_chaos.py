@@ -603,3 +603,33 @@ class TestChaosSession:
         out = c2.report(lease["lease_id"], jid,
                         lease["tasks"][0]["job_epoch"], "succeeded", {})
         assert out["accepted"] is False and out["reason"] == "stale epoch"
+
+
+class TestPreemptionFaultKinds:
+    """ISSUE 10: spot_reclaim / hard_kill join the seeded plan — same
+    Bernoulli machinery, counted, and zero-probability kinds stay inert
+    without consuming randomness (the cross-kind determinism guarantee)."""
+
+    def test_seeded_counts_are_deterministic(self):
+        a = FaultPlan(seed=13, spot_reclaim=0.5, hard_kill=0.25)
+        b = FaultPlan(seed=13, spot_reclaim=0.5, hard_kill=0.25)
+        seq_a = [(a.decide("spot_reclaim"), a.decide("hard_kill"))
+                 for _ in range(200)]
+        seq_b = [(b.decide("spot_reclaim"), b.decide("hard_kill"))
+                 for _ in range(200)]
+        assert seq_a == seq_b
+        assert a.counts == b.counts
+        assert a.counts.get("spot_reclaim", 0) > 0
+        assert a.counts.get("hard_kill", 0) > 0
+
+    def test_zero_probability_consumes_no_randomness(self):
+        # Enabling the preemption kinds at p=0 must not perturb the draw
+        # sequence of any other kind.
+        ref = FaultPlan(seed=5, drop_request=0.5)
+        mixed = FaultPlan(seed=5, drop_request=0.5,
+                          spot_reclaim=0.0, hard_kill=0.0)
+        for _ in range(100):
+            assert mixed.decide("spot_reclaim") is False
+            assert mixed.decide("hard_kill") is False
+            assert mixed.decide("drop_request") == ref.decide("drop_request")
+        assert "spot_reclaim" not in mixed.counts

@@ -360,8 +360,11 @@ def test_status_endpoint_schema():
 
     assert set(body) == {
         "counts", "counts_by_op", "queue_depth", "drained", "stale_results",
-        "agents", "summary", "last_metrics",
+        "agents", "summary", "journal", "last_metrics",
     }
+    # ISSUE 10 satellite: journal replay damage is operator-visible.
+    assert body["journal"] == {"torn_tail": 0, "replay_skipped": 0}
+    assert body["agents"]["a1"]["draining"] is False
     assert body["counts"] == {"succeeded": 1, "pending": 2}
     assert body["counts_by_op"] == {
         "echo": {"succeeded": 1, "pending": 1},
@@ -438,3 +441,148 @@ def test_http_job_result_retrieval():
             raise AssertionError("expected 404")
         except urllib.error.HTTPError as exc:
             assert exc.code == 404
+
+
+class TestDrainProtocol:
+    """ISSUE 10: the `released` handback and the `draining` agent mark."""
+
+    def test_released_requeues_without_burning_the_attempt(self):
+        c = Controller()
+        jid = c.submit("echo", {})
+        lease = c.lease("a1", {"ops": ["echo"]})
+        task = lease["tasks"][0]
+        out = c.report(lease["lease_id"], jid, task["job_epoch"], "released")
+        assert out == {"accepted": True, "released": True}
+        snap = c.job_snapshot(jid)
+        # Instantly leasable again, epoch fenced, attempt given back.
+        assert snap["state"] == "pending"
+        assert snap["job_epoch"] == task["job_epoch"] + 1
+        assert snap["attempts"] == 0
+        # The stale duplicate of the released lease is fenced off.
+        dup = c.report(
+            lease["lease_id"], jid, task["job_epoch"], "succeeded", {"ok": 1}
+        )
+        assert dup["accepted"] is False
+        # A fresh lease completes the job normally with a fresh attempt.
+        lease2 = c.lease("a2", {"ops": ["echo"]})
+        task2 = lease2["tasks"][0]
+        assert task2["id"] == jid
+        out = c.report(
+            lease2["lease_id"], jid, task2["job_epoch"], "succeeded",
+            {"ok": True},
+        )
+        assert out["accepted"] is True
+        assert c.job_snapshot(jid)["attempts"] == 1
+
+    def test_release_of_unleased_job_rejected(self):
+        clock = FakeClock()
+        c = Controller(lease_ttl_sec=5.0, clock=clock)
+        jid = c.submit("echo", {})
+        lease = c.lease("a1", {"ops": ["echo"]})
+        epoch = lease["tasks"][0]["job_epoch"]
+        # TTL expires first: the job re-queued at a bumped epoch, so the
+        # late release is a stale epoch, counted not applied.
+        clock.t += 10.0
+        c.sweep()
+        out = c.report(lease["lease_id"], jid, epoch, "released")
+        assert out["accepted"] is False and out["reason"] == "stale epoch"
+        # A release against a terminal job is a duplicate, not a requeue.
+        lease2 = c.lease("a2", {"ops": ["echo"]})
+        task2 = lease2["tasks"][0]
+        c.report(lease2["lease_id"], jid, task2["job_epoch"], "succeeded",
+                 {"ok": True})
+        out = c.report(lease2["lease_id"], jid, task2["job_epoch"],
+                       "released")
+        assert out["accepted"] is False
+        assert c.job_snapshot(jid)["state"] == "succeeded"
+
+    def test_released_requeue_is_journaled_for_replay(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        c = Controller(journal_path=path)
+        jid = c.submit("echo", {})
+        lease = c.lease("a1", {"ops": ["echo"]})
+        c.report(lease["lease_id"], jid, lease["tasks"][0]["job_epoch"],
+                 "released")
+        c.close()
+        replayed = Controller(journal_path=path)
+        snap = replayed.job_snapshot(jid)
+        # The fence survived the restart: epoch 1, pending, re-queued.
+        assert snap["state"] == "pending" and snap["job_epoch"] == 1
+        assert replayed.queue_depth() == 1
+        replayed.close()
+
+    def test_draining_mark_sets_and_clears(self):
+        c = Controller()
+        c.lease("a1", {"ops": []}, max_tasks=0, metrics={"cpu_util": 0.1},
+                draining=True)
+        assert c.agents_summary()["a1"]["draining"] is True
+        assert c.health_json()["agents"]["a1"]["draining"] is True
+        # A fresh incarnation under the same name clears the mark.
+        c.lease("a1", {"ops": []}, max_tasks=0, metrics={"cpu_util": 0.1})
+        assert c.agents_summary()["a1"]["draining"] is False
+
+    def test_draining_metrics_only_flush_still_ingests_telemetry(self):
+        """The retiring agent's final metrics-only lease (satellite 4):
+        nothing leases, the snapshot lands, the scheduler's queue is
+        untouched, and a previously-unseen agent gets a minimal entry."""
+        c = Controller()
+        jid = c.submit("echo", {})
+        out = c.lease(
+            "drainer", {"ops": ["echo"]}, max_tasks=0,
+            metrics={"obs": {"tasks_total": {
+                "type": "counter", "help": "", "labels": ["op", "status"],
+                "series": [{"labels": {"op": "echo", "status": "succeeded"},
+                            "value": 3}],
+            }}},
+            draining=True,
+        )
+        assert out is None                      # metrics-only: no tasks
+        assert c.queue_depth() == 1             # queue untouched
+        assert c.agents_summary()["drainer"]["draining"] is True
+        assert c.fleet_snapshot().get("tasks_total")  # snapshot ingested
+        # draining flag alone (no metrics) also creates a minimal entry.
+        out = c.lease("ghost", None, max_tasks=0, draining=True)
+        assert out is None
+        assert c.agents_summary()["ghost"]["draining"] is True
+        # And the pending job still leases normally to a live agent.
+        lease = c.lease("live", {"ops": ["echo"]})
+        assert lease["tasks"][0]["id"] == jid
+
+
+class TestJournalStatusCounters:
+    """ISSUE 10 satellite: torn-final-line vs mid-file corruption counted
+    distinctly AND operator-visible in /v1/status."""
+
+    def test_torn_tail_and_skipped_visible_in_status(self, tmp_path):
+        import json as _json
+        import urllib.request
+
+        from agent_tpu.controller.server import ControllerServer
+
+        path = str(tmp_path / "journal.jsonl")
+        c = Controller(journal_path=path)
+        c.submit("echo", {}, job_id="j-keep")
+        c.close()
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"ev": "corrupt-mid\n')      # mid-file damage
+            f.write('{"ev": "submit", "job_id": "j2", "op": "echo", '
+                    '"payload": {}}\n')
+            f.write('{"ev": "result", "job_id"')  # torn final write
+        replayed = Controller(journal_path=path)
+        assert replayed.journal_torn_tail == 1
+        assert replayed.journal_replay_skipped == 1
+        with ControllerServer(replayed) as srv:
+            with urllib.request.urlopen(srv.url + "/v1/status") as r:
+                body = _json.loads(r.read())
+        assert body["journal"] == {"torn_tail": 1, "replay_skipped": 1}
+        replayed.close()
+
+    def test_clean_journal_reports_zero(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        c = Controller(journal_path=path)
+        c.submit("echo", {})
+        c.close()
+        replayed = Controller(journal_path=path)
+        assert replayed.journal_torn_tail == 0
+        assert replayed.journal_replay_skipped == 0
+        replayed.close()

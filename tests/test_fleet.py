@@ -257,3 +257,74 @@ class TestMpmdPipeline:
              "model_config": dict(TINY_S2S)}, ctx)
         assert dec["summaries"][1] == ""
         assert dec["summaries"][0] != ""
+
+
+class TestWaitForAgents:
+    """ISSUE 10 satellite: the readiness gate's timeout and
+    partial-readiness paths (only the happy path was covered)."""
+
+    def _agents_fn(self, *snapshots):
+        """agents_fn returning successive snapshots, then the last forever."""
+        seq = list(snapshots)
+
+        def fn():
+            return seq.pop(0) if len(seq) > 1 else seq[0]
+
+        return fn
+
+    def test_all_ready_immediately(self):
+        from agent_tpu.agent.fleet import wait_for_agents
+
+        fn = self._agents_fn({"a": {}, "b": {}})
+        assert wait_for_agents(fn, ["a", "b"], timeout=1.0) is True
+
+    def test_partial_readiness_converges(self):
+        from agent_tpu.agent.fleet import wait_for_agents
+
+        fn = self._agents_fn({}, {"a": {}}, {"a": {}, "b": {}})
+        assert wait_for_agents(fn, ["a", "b"], timeout=5.0) is True
+
+    def test_partial_readiness_times_out(self):
+        import time
+
+        from agent_tpu.agent.fleet import wait_for_agents
+
+        t0 = time.monotonic()
+        fn = self._agents_fn({"a": {}})  # b never reports in
+        assert wait_for_agents(fn, ["a", "b"], timeout=0.4) is False
+        assert time.monotonic() - t0 >= 0.3  # actually waited the window
+
+    def test_agents_fn_errors_tolerated_until_timeout(self):
+        from agent_tpu.agent.fleet import wait_for_agents
+
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("controller still booting")
+            return {"a": {}}
+
+        assert wait_for_agents(flaky, ["a"], timeout=5.0) is True
+        assert calls["n"] >= 3
+
+    def test_dead_member_aborts_the_wait(self):
+        from agent_tpu.agent.fleet import Fleet, wait_for_agents
+
+        class DeadProc:
+            returncode = 3
+
+            def poll(self):
+                return 3
+
+        fleet = Fleet([DeadProc()], ["a"])
+        # b never reports AND a member already exited nonzero: fail fast,
+        # not at the timeout.
+        import time
+
+        t0 = time.monotonic()
+        ok = wait_for_agents(
+            self._agents_fn({}), ["a"], timeout=30.0, fleet=fleet
+        )
+        assert ok is False
+        assert time.monotonic() - t0 < 5.0

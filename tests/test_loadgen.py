@@ -1,0 +1,214 @@
+"""Open-loop traffic generator (ISSUE 10): seeded determinism, rate
+shaping (burst/diurnal), class mixing, and the open-loop drop semantics."""
+
+import pytest
+
+from agent_tpu.config import LoadgenConfig
+from agent_tpu.loadgen import (
+    Arrival,
+    ArrivalPattern,
+    LoadGen,
+    Rejected,
+    TrafficClass,
+    session_submitter,
+)
+
+
+def _classes():
+    return [
+        TrafficClass(name="interactive", op="probe", weight=3.0,
+                     tenant="rt1", priority=8, deadline_sec=30.0,
+                     payload={"sleep_ms": 5}),
+        TrafficClass(name="bulk", op="shard", weight=1.0, tenant="bulk"),
+    ]
+
+
+class TestArrivalPattern:
+    def test_burst_multiplies_rate_inside_window_only(self):
+        p = ArrivalPattern(2.0, bursts=[(4.0, 8.0, 10.0)])
+        assert p.rate(2.0) == pytest.approx(2.0)
+        assert p.rate(5.0) == pytest.approx(20.0)
+        assert p.rate(8.0) == pytest.approx(2.0)  # window is half-open
+        assert p.peak_rate() >= 20.0
+
+    def test_diurnal_swings_but_never_negative(self):
+        p = ArrivalPattern(1.0, diurnal_amplitude=1.0,
+                           diurnal_period_sec=10.0)
+        rates = [p.rate(t / 10.0) for t in range(0, 101)]
+        assert min(rates) >= 0.0
+        assert max(rates) == pytest.approx(2.0, abs=0.05)
+
+    def test_from_config_wires_the_env_surface(self):
+        cfg = LoadgenConfig(base_rate=3.0, burst_factor=5.0,
+                            burst_at_sec=1.0, burst_len_sec=2.0,
+                            diurnal_amplitude=0.5)
+        p = ArrivalPattern.from_config(cfg)
+        assert p.rate(2.0) > p.rate(0.0)
+        assert p.bursts == [(1.0, 3.0, 5.0)]
+
+
+class TestSchedule:
+    def test_same_seed_same_schedule(self):
+        gen = LoadGen(_classes(), ArrivalPattern(5.0), seed=42)
+        a = gen.schedule(10.0)
+        b = gen.schedule(10.0)
+        assert [(x.t, x.cls.name, x.payload, x.seq) for x in a] == \
+               [(x.t, x.cls.name, x.payload, x.seq) for x in b]
+        assert len(a) > 10
+
+    def test_different_seed_different_schedule(self):
+        base = ArrivalPattern(5.0)
+        a = LoadGen(_classes(), base, seed=1).schedule(10.0)
+        b = LoadGen(_classes(), base, seed=2).schedule(10.0)
+        assert [x.t for x in a] != [x.t for x in b]
+
+    def test_burst_density_tracks_the_factor(self):
+        p = ArrivalPattern(4.0, bursts=[(10.0, 20.0, 10.0)])
+        arrivals = LoadGen(_classes(), p, seed=7).schedule(30.0)
+        calm = sum(1 for x in arrivals if x.t < 10.0)
+        burst = sum(1 for x in arrivals if 10.0 <= x.t < 20.0)
+        # 10× the rate over equal windows; allow generous Poisson noise.
+        assert burst > 5 * max(1, calm)
+
+    def test_class_mix_follows_weights(self):
+        arrivals = LoadGen(_classes(), ArrivalPattern(50.0), seed=3
+                           ).schedule(10.0)
+        n = len(arrivals)
+        interactive = sum(
+            1 for x in arrivals if x.cls.name == "interactive"
+        )
+        assert n > 100
+        assert 0.6 < interactive / n < 0.9  # weight 3:1
+
+    def test_zero_rate_or_duration_yields_nothing(self):
+        assert LoadGen(_classes(), ArrivalPattern(0.0)).schedule(10.0) == []
+        assert LoadGen(_classes(), ArrivalPattern(5.0)).schedule(0.0) == []
+
+    def test_rejects_bad_class_mixes(self):
+        with pytest.raises(ValueError):
+            LoadGen([], ArrivalPattern(1.0))
+        with pytest.raises(ValueError):
+            LoadGen([TrafficClass(name="x", op="o", weight=-1.0)],
+                    ArrivalPattern(1.0))
+        with pytest.raises(ValueError):
+            LoadGen([TrafficClass(name="x", op="o", weight=0.0)],
+                    ArrivalPattern(1.0))
+
+    def test_payload_fn_is_seed_deterministic(self):
+        cls = TrafficClass(
+            name="x", op="o",
+            payload_fn=lambda rng, seq: {"v": rng.randrange(1000),
+                                         "seq": seq},
+        )
+        gen = LoadGen([cls], ArrivalPattern(10.0), seed=9)
+        assert [a.payload for a in gen.schedule(5.0)] == \
+               [a.payload for a in gen.schedule(5.0)]
+
+
+class TestRun:
+    def _gen(self, rate=50.0, seed=4):
+        return LoadGen(_classes(), ArrivalPattern(rate), seed=seed)
+
+    def test_open_loop_submits_everything_and_records_ledger(self):
+        gen = self._gen()
+        n_sched = len(gen.schedule(2.0))
+        ids = iter(range(10_000))
+
+        # Virtual clock: no real sleeping in tests.
+        clock = {"t": 0.0}
+        stats = gen.run(
+            lambda a: f"job-{next(ids)}", 2.0,
+            now=lambda: clock["t"],
+            sleep=lambda s: clock.__setitem__("t", clock["t"] + s),
+        )
+        assert stats.total_submitted() == n_sched
+        assert len(stats.jobs) == n_sched
+        assert stats.job_ids("interactive")
+        assert stats.total_rejected() == 0
+
+    def test_rejections_drop_not_retry(self):
+        gen = self._gen()
+        calls = {"n": 0}
+
+        def submit(arrival):
+            calls["n"] += 1
+            if calls["n"] % 3 == 0:
+                raise Rejected("429")
+            return f"job-{calls['n']}"
+
+        clock = {"t": 0.0}
+        stats = gen.run(
+            submit, 1.0, now=lambda: clock["t"],
+            sleep=lambda s: clock.__setitem__("t", clock["t"] + s),
+        )
+        assert stats.total_rejected() > 0
+        # Open loop: every arrival got exactly one submit attempt.
+        assert calls["n"] == stats.total_submitted() + stats.total_rejected()
+
+    def test_submit_errors_counted_not_fatal(self):
+        gen = self._gen(rate=20.0)
+        clock = {"t": 0.0}
+
+        def submit(arrival):
+            raise RuntimeError("controller blip")
+
+        stats = gen.run(
+            submit, 1.0, now=lambda: clock["t"],
+            sleep=lambda s: clock.__setitem__("t", clock["t"] + s),
+        )
+        assert stats.total_submitted() == 0
+        assert sum(stats.errors.values()) > 0
+
+
+class TestSessionSubmitter:
+    class _Resp:
+        def __init__(self, status, body=None):
+            self.status_code = status
+            self._body = body or {}
+
+        def json(self):
+            return self._body
+
+    def test_submits_class_fields_and_parses_job_id(self):
+        seen = []
+
+        class Session:
+            def post(self, url, json=None, timeout=None):
+                seen.append((url, json))
+                return TestSessionSubmitter._Resp(200, {"job_id": "j-1"})
+
+        submit = session_submitter(Session(), "http://ctl")
+        cls = _classes()[0]
+        jid = submit(Arrival(0.0, cls, {"sleep_ms": 5}, 0))
+        assert jid == "j-1"
+        url, body = seen[0]
+        assert url == "http://ctl/v1/jobs"
+        assert body["tenant"] == "rt1" and body["priority"] == 8
+        assert body["deadline_sec"] == 30.0
+        assert body["payload"] == {"sleep_ms": 5}
+
+    def test_429_raises_rejected_others_raise_runtime(self):
+        class Session:
+            def __init__(self, status):
+                self.status = status
+
+            def post(self, url, json=None, timeout=None):
+                return TestSessionSubmitter._Resp(self.status, {})
+
+        cls = _classes()[1]
+        with pytest.raises(Rejected):
+            session_submitter(Session(429))(Arrival(0.0, cls, {}, 0))
+        with pytest.raises(RuntimeError):
+            session_submitter(Session(500))(Arrival(0.0, cls, {}, 0))
+
+    def test_loopback_round_trip(self):
+        from agent_tpu.chaos import LoopbackSession
+        from agent_tpu.controller.core import Controller
+
+        c = Controller()
+        submit = session_submitter(LoopbackSession(c))
+        cls = _classes()[0]
+        jid = submit(Arrival(0.0, cls, {"sleep_ms": 1}, 0))
+        snap = c.job_snapshot(jid)
+        assert snap["tenant"] == "rt1" and snap["priority"] == 8
+        assert snap["deadline_sec"] == 30.0

@@ -22,8 +22,13 @@ import tempfile
 
 import numpy as np
 import pytest
-from hypothesis import HealthCheck, example, given, settings
-from hypothesis import strategies as st
+
+# The whole module is hypothesis-driven; environments without the optional
+# dependency must SKIP it, not error at collection (the rest of tier-1 ran
+# with `--continue-on-collection-errors` hiding this for two rounds).
+pytest.importorskip("hypothesis")
+from hypothesis import HealthCheck, example, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from agent_tpu.config import DeviceConfig
 from agent_tpu.runtime import TpuRuntime
