@@ -197,6 +197,10 @@ class Agent:
             "Per-device accelerator memory from memory_stats(), across ALL "
             "local devices (absent on backends that report none — CPU)",
             ("device", "kind"))
+        self.m_failover = self.obs.counter(
+            "controller_failovers_total",
+            "Active-controller rotations after transport errors "
+            "(CONTROLLER_URLS failover list)")
         self.m_post_fail = self.obs.counter(
             "result_post_failures_total",
             "Result posts that failed (then spooled, or dropped if the "
@@ -279,8 +283,46 @@ class Agent:
         # the ledger turns into chip-seconds (a dp=8 dispatch second spans
         # 8 chips). Cached on first use; 1 without a runtime.
         self._usage_chips: Optional[float] = None
+        # Controller failover list (ISSUE 14): CONTROLLER_URLS candidates,
+        # primary first. A transport error rotates the active index
+        # (sticky on success), so spool redelivery and the lease loop
+        # follow a promoted hot standby without restarting the agent.
+        # Index updates race benignly across the lease/poster threads.
+        urls = list(a.controller_urls) or [a.controller_url]
+        if a.controller_url not in urls:
+            urls.insert(0, a.controller_url)
+        self._controller_urls = urls
+        self._url_index = 0
 
     # ---- controller I/O ----
+
+    def active_controller_url(self) -> str:
+        """The controller currently targeted — rotates through the
+        CONTROLLER_URLS failover list on transport errors (ISSUE 14)."""
+        urls = self._controller_urls
+        return urls[self._url_index % len(urls)]
+
+    def _note_transport_error(self, url: str) -> None:
+        """Rotate to the next failover candidate. Only meaningful with
+        ≥ 2 URLs; self-correcting — if the next candidate is also down,
+        the following error rotates again, and a success pins the index
+        wherever it landed."""
+        urls = self._controller_urls
+        if len(urls) < 2:
+            return
+        # Another thread may have rotated already; only advance past the
+        # URL that actually failed so concurrent errors rotate once.
+        if urls[self._url_index % len(urls)] == url:
+            self._url_index = (self._url_index + 1) % len(urls)
+            self.m_failover.inc()
+            self.recorder.record(
+                "controller_failover", failed=url,
+                active=urls[self._url_index],
+            )
+            log(
+                "controller unreachable — failing over",
+                failed=url, active=urls[self._url_index],
+            )
 
     def _post_json(
         self, path: str, body: Dict[str, Any], session: Any = None
@@ -289,12 +331,16 @@ class Agent:
         parse falls back to raw text (reference ``app.py:143-158``).
         ``session`` overrides the agent's session — the pipelined poster
         thread brings its own (requests.Session is not thread-safe)."""
-        url = f"{self.config.agent.controller_url}{path}"
+        base = self.active_controller_url()
+        url = f"{base}{path}"
         try:
             resp = (session or self.session).post(
                 url, json=body, timeout=self.config.agent.http_timeout_sec
             )
         except Exception as exc:  # noqa: BLE001 — any transport failure
+            # Failover (ISSUE 14): the retry/spool machinery redelivers —
+            # to the NEXT candidate once the list rotates.
+            self._note_transport_error(base)
             return STATUS_TRANSPORT_ERROR, repr(exc)
         if resp.status_code == 204:
             return 204, None

@@ -60,6 +60,9 @@ class FaultPlan:
     capacity disappears — and ``hard_kill`` — SIGKILL mid-execute, no
     drain: in-flight work is lost and must be recovered by lease-TTL
     expiry + epoch fencing while the autoscaler replaces the capacity.
+    ``controller_kill`` (ISSUE 14) SIGKILLs the PRIMARY CONTROLLER itself
+    mid-drain; recovery is hot-standby promotion + agent CONTROLLER_URLS
+    failover + spool redelivery.
     """
 
     seed: int = 0
@@ -79,6 +82,14 @@ class FaultPlan:
     # preemption faults (ISSUE 10): decided per live member per churn tick
     spot_reclaim: float = 0.0
     hard_kill: float = 0.0
+    # control-plane fault (ISSUE 14): SIGKILL the PRIMARY CONTROLLER
+    # mid-drain — no close(), no journal fsync, a possibly-torn final
+    # journal line. Recovery is the hot-standby promotion path
+    # (controller/standby.py): journal tail + seal + epoch-fenced requeue,
+    # with agents failing over via CONTROLLER_URLS and the spool
+    # redelivering completed results to the new incarnation. Decided by
+    # the soak harness per tick (scripts/controller_failover_soak.py).
+    controller_kill: float = 0.0
     counts: Dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:
@@ -180,6 +191,12 @@ class LoopbackSession:
                 job_id = self.controller.submit(
                     op=str(body.get("op", "")),
                     payload=body.get("payload"),
+                    # Client-chosen id (ISSUE 14): same exactly-once
+                    # resubmission contract as controller/server.py.
+                    job_id=(
+                        str(body["job_id"])
+                        if body.get("job_id") is not None else None
+                    ),
                     required_labels=body.get("required_labels"),
                     max_attempts=body.get("max_attempts"),
                     priority=body.get("priority"),

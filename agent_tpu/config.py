@@ -96,6 +96,11 @@ class AgentConfig:
     """Control-plane configuration (reference ``app.py:19-44``)."""
 
     controller_url: str = "http://10.11.12.54:8080"
+    # Controller failover list (ISSUE 14): ordered candidates the agent
+    # rotates through when the active one is unreachable (transport error)
+    # — how spooled results redeliver to a promoted hot standby instead of
+    # waiting out a dead primary. Empty = just controller_url.
+    controller_urls: Tuple[str, ...] = ()
     agent_name: str = field(default_factory=socket.gethostname)
     http_timeout_sec: float = 10.0
     idle_sleep_sec: float = 0.25
@@ -144,8 +149,19 @@ class AgentConfig:
 
     @staticmethod
     def from_env() -> "AgentConfig":
+        urls = tuple(
+            u.strip().rstrip("/")
+            for u in env_str("CONTROLLER_URLS", "").split(",")
+            if u.strip()
+        )
         return AgentConfig(
-            controller_url=env_str("CONTROLLER_URL", "http://10.11.12.54:8080").rstrip("/"),
+            # The failover list's head doubles as the primary, so setting
+            # CONTROLLER_URLS alone is enough; CONTROLLER_URL wins when
+            # both are set (the historical contract).
+            controller_url=env_str(
+                "CONTROLLER_URL", urls[0] if urls else "http://10.11.12.54:8080"
+            ).rstrip("/"),
+            controller_urls=urls,
             agent_name=env_str("AGENT_NAME", socket.gethostname()),
             http_timeout_sec=env_float("HTTP_TIMEOUT_SEC", 10.0),
             idle_sleep_sec=env_float("IDLE_SLEEP_SEC", 0.25),
@@ -272,6 +288,62 @@ class SizingConfig:
             cpu_min_workers=env_int("CPU_MIN_WORKERS", 1),
             cpu_soft_cap_multiplier=env_int("CPU_SOFT_CAP_MULTIPLIER", 8),
             cpu_per_worker_bytes=env_int("CPU_PER_WORKER_BYTES", 32 * 1024 * 1024),
+        )
+
+
+@dataclass(frozen=True)
+class JournalConfig:
+    """Controller journal durability knobs (ISSUE 14 — the JOURNAL_* /
+    SNAPSHOT_* env surface, consumed by ``controller/journal.py``).
+
+    Everything defaults to the historical behavior: one append-only JSONL
+    file at ``CONTROLLER_JOURNAL``, flushed but never fsynced, never
+    rotated. Setting any segmentation/snapshot knob switches the journal
+    to bounded ``<path>.seg-NNNNNNNN`` segments with periodic atomic
+    ``<path>.snapshot`` images, after which replay cost is O(live state +
+    uncovered tail) instead of O(history) and covered segments are
+    garbage-collected."""
+
+    # Rotate the active segment past this size / event count (0 = never —
+    # the legacy single-file journal).
+    segment_max_bytes: int = 0            # JOURNAL_SEGMENT_MAX_BYTES
+    segment_max_events: int = 0           # JOURNAL_SEGMENT_MAX_EVENTS
+    # Take a compacting snapshot every N journal appends (0 = never).
+    # Implies segmentation (default 4 MiB segments when no bound is set).
+    snapshot_every_events: int = 0        # SNAPSHOT_EVERY_EVENTS
+    # Terminal-job retention in snapshots: 0 = keep every terminal job
+    # forever (full restart fidelity, unbounded snapshot growth); N =
+    # snapshots keep only the N most recent *droppable* terminal jobs
+    # (jobs a non-terminal job depends on are never dropped). A restart
+    # then forgets older completed jobs: late duplicate results for them
+    # reject as `unknown job` instead of `already complete` — the same
+    # at-most-once outcome — and this is what makes restart cost O(live
+    # state) instead of O(every job ever submitted).
+    snapshot_retain_terminal: int = 0     # SNAPSHOT_RETAIN_TERMINAL
+    # fdatasync journal appends: off by default — the journal protects
+    # against process death (flushed OS buffers survive SIGKILL), not
+    # kernel/power loss; turning this on buys the latter at per-append
+    # syscall cost. fsync_every=N batches the sync (group commit).
+    fsync: bool = False                   # JOURNAL_FSYNC
+    fsync_every: int = 1                  # JOURNAL_FSYNC_EVERY
+
+    @staticmethod
+    def from_env() -> "JournalConfig":
+        return JournalConfig(
+            segment_max_bytes=max(
+                0, env_int("JOURNAL_SEGMENT_MAX_BYTES", 0)
+            ),
+            segment_max_events=max(
+                0, env_int("JOURNAL_SEGMENT_MAX_EVENTS", 0)
+            ),
+            snapshot_every_events=max(
+                0, env_int("SNAPSHOT_EVERY_EVENTS", 0)
+            ),
+            snapshot_retain_terminal=max(
+                0, env_int("SNAPSHOT_RETAIN_TERMINAL", 0)
+            ),
+            fsync=env_bool("JOURNAL_FSYNC", False),
+            fsync_every=max(1, env_int("JOURNAL_FSYNC_EVERY", 1)),
         )
 
 

@@ -49,19 +49,29 @@ Two durability/liveness extras beyond the reference protocol:
   some other job depends on (reduce partials); journaling every drain shard's
   output would duplicate the whole dataset, so operators should fetch map
   results as shards complete (GET ``/v1/jobs/<id>``) or add a reduce stage.
+  ISSUE 14 bounds the replay cost: with the ``JOURNAL_*``/``SNAPSHOT_*``
+  knobs set, the journal rotates into segments with periodic compacting
+  snapshots (``controller/journal.py``) so restart is O(live state), and a
+  hot standby (``controller/standby.py``) can tail it and promote with
+  epoch fencing when this process dies.
 """
 
 from __future__ import annotations
 
-import json
-import os
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
-from agent_tpu.config import ObsConfig, TRUTHY_TOKENS, SchedConfig, SloConfig
+from agent_tpu.config import (
+    JournalConfig,
+    ObsConfig,
+    TRUTHY_TOKENS,
+    SchedConfig,
+    SloConfig,
+)
+from agent_tpu.controller.journal import SegmentedJournal
 from agent_tpu.data import wire
 from agent_tpu.obs.health import build_health
 from agent_tpu.obs.profile import CaptureCoordinator, HostProfiler
@@ -211,6 +221,7 @@ class Controller:
         wire_binary: bool = True,
         slo: Optional[SloConfig] = None,
         obs: Optional[ObsConfig] = None,
+        journal: Optional[JournalConfig] = None,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
         # Binary shard wire (ISSUE 6): False = never negotiate (a JSON-only
@@ -286,6 +297,30 @@ class Controller:
         self._m_journal_torn = m.counter(
             "controller_journal_torn_tail_total",
             "Journal replays that found a torn (unparseable) final line")
+        # Journal durability surface (ISSUE 14): segmentation/compaction
+        # bookkeeping + the replay-cost number the micro-bench tracks.
+        self._m_snapshots = m.counter(
+            "controller_journal_snapshots_total",
+            "Compacting journal snapshots committed (atomic tmp+rename)")
+        self._m_snapshot_invalid = m.counter(
+            "controller_journal_snapshot_invalid_total",
+            "Snapshots ignored as invalid/half-written at replay (replay "
+            "fell back to full segments)")
+        self._m_segments = m.gauge(
+            "controller_journal_segments",
+            "Journal segment files currently on disk")
+        self._m_journal_disk_bytes = m.gauge(
+            "controller_journal_bytes",
+            "Journal bytes on disk (segments; snapshot excluded)")
+        self._m_snapshot_age = m.gauge(
+            "controller_journal_snapshot_age_seconds",
+            "Age of the newest compacting snapshot")
+        self._m_replay_sec = m.gauge(
+            "controller_journal_replay_seconds",
+            "Wall-clock duration of this incarnation's journal replay")
+        self._m_promotions = m.counter(
+            "controller_promotions_total",
+            "Hot-standby promotions completed by this process")
         # Scheduler observability (ISSUE 4): decision counters, per-tenant
         # queue depth, and how long jobs waited before their first lease
         # (the starvation signal the fair policy exists to bound).
@@ -397,10 +432,28 @@ class Controller:
         # clean" reads off one status call, not a metrics scrape.
         self.journal_torn_tail = 0
         self.journal_replay_skipped = 0
-        self._journal_file = None
+        # Replay cost, the number compaction exists to bound (ISSUE 14):
+        # wall seconds + events this incarnation replayed before serving.
+        self.journal_replay_sec = 0.0
+        self.journal_replayed_events = 0
+        self.promotions = 0
+        self.journal_config = journal if journal is not None \
+            else JournalConfig()
+        self._journal_impl: Optional[SegmentedJournal] = None
         if journal_path:
-            self._replay_journal(journal_path)
-            self._journal_file = open(journal_path, "a", encoding="utf-8")
+            impl = SegmentedJournal(
+                journal_path,
+                segment_max_bytes=self.journal_config.segment_max_bytes,
+                segment_max_events=self.journal_config.segment_max_events,
+                snapshot_every_events=(
+                    self.journal_config.snapshot_every_events
+                ),
+                fsync=self.journal_config.fsync,
+                fsync_every=self.journal_config.fsync_every,
+            )
+            self._replay_journal(impl)
+            impl.open_for_append()
+            self._journal_impl = impl
         self._sweeper: Optional[threading.Thread] = None
         self._sweep_stop = threading.Event()
         if sweep_interval_sec:
@@ -578,114 +631,116 @@ class Controller:
 
     def _journal(self, event: Dict[str, Any]) -> None:
         # Caller holds the lock; writes are ordered with the state changes
-        # they record. fsync is deliberately skipped: the journal protects
-        # against controller restarts, not kernel crashes, and a 10M-row
-        # drain posts thousands of shard results.
-        if self._journal_file is not None:
-            self._journal_file.write(json.dumps(event) + "\n")
-            self._journal_file.flush()
+        # they record. fsync is opt-in (JOURNAL_FSYNC — ISSUE 14): by
+        # default the journal protects against controller restarts, not
+        # kernel crashes, and a 10M-row drain posts thousands of results.
+        if self._journal_impl is not None:
+            self._journal_impl.append(event)
             self._m_journal_writes.inc(ev=str(event.get("ev", "?")))
 
-    def _replay_journal(self, path: str) -> None:
-        """Rebuild job state from a previous incarnation's journal. Runs
-        before the journal opens for append, without the lock (no other
-        thread can hold a reference yet)."""
-        if not os.path.exists(path):
-            return
-        with open(path, "r", encoding="utf-8") as f:
-            lines = f.read().splitlines()
-        skipped: List[int] = []
-        for i, raw in enumerate(lines):
-            line = raw.strip()
-            if not line:
-                continue
-            try:
-                ev = json.loads(line)
-            except ValueError:
-                if i == len(lines) - 1:
-                    # Torn FINAL write from a crash — an expected failure
-                    # mode, tolerated; but no longer silently (ISSUE 4
-                    # satellite): a counted warning distinguishes "the
-                    # controller died mid-append" from a pristine journal.
-                    self._m_journal_torn.inc()
-                    self.journal_torn_tail += 1
-                    log(
-                        "journal replay tolerated a torn final line",
-                        path=path, line=i + 1,
-                    )
-                    continue
-                # Mid-file corruption is NOT a torn write: something else
-                # damaged the journal. Skipping silently would quietly
-                # resurrect or lose jobs, so count + warn (ISSUE 3 satellite).
-                skipped.append(i + 1)
-                continue
-            if ev.get("ev") == "submit":
-                after_order = tuple(ev.get("after") or ())
-                raw_max = ev.get("max_attempts")
-                raw_deadline = ev.get("deadline_sec")
-                self._jobs[ev["job_id"]] = Job(
-                    job_id=ev["job_id"],
-                    op=ev["op"],
-                    payload=ev.get("payload") or {},
-                    after=set(after_order),
-                    after_order=after_order,
-                    required_labels=ev.get("required_labels") or {},
-                    max_attempts=int(raw_max) if raw_max else None,
-                    # Journal schema vN+1 (ISSUE 4): scheduling fields ride
-                    # the submit record only when the submitter set them, so
-                    # old journals (and default submissions) replay — and
-                    # re-journal — byte-identically.
-                    priority=int(
-                        ev.get("priority", self.sched_config.default_priority)
-                    ),
-                    tenant=str(ev.get("tenant", DEFAULT_TENANT)),
-                    deadline_sec=float(raw_deadline) if raw_deadline else None,
-                )
-                self._depended_on.update(after_order)
-            elif ev.get("ev") == "result":
-                job = self._jobs.get(ev.get("job_id"))
-                if job is None:
-                    continue
-                job.state = ev.get("state", job.state)
-                job.epoch = int(ev.get("epoch", job.epoch))
-                job.attempts = int(ev.get("attempts", job.attempts))
-                job.result = ev.get("result")
-                job.error = ev.get("error")
-                if self.usage is not None and isinstance(
-                    ev.get("usage"), dict
-                ):
-                    # Replay-correct showback (ISSUE 9): billed usage rides
-                    # the result event, so a restarted controller's
-                    # /v1/usage reports the same totals the dead one did.
-                    self.usage.bill(
-                        job.job_id, tenant=job.tenant, tier=job.priority,
-                        op=job.op, attempt=ev.get("attempts", 0),
-                        usage=ev["usage"],
-                    )
-            elif ev.get("ev") == "requeue":
-                # Lease-expiry epoch bump: must replay, or a result the
-                # previous incarnation had fenced off could be accepted
-                # after restart (its epoch would collide with ours).
-                job = self._jobs.get(ev.get("job_id"))
-                if job is not None:
-                    job.epoch = int(ev.get("epoch", job.epoch))
-        if skipped:
-            self.journal_replay_skipped += len(skipped)
-            self._m_journal_skipped.inc(len(skipped))
-            log(
-                "journal replay skipped unparseable mid-file lines",
-                path=path, count=len(skipped), lines=skipped[:20],
+    def _apply_replay_event(self, ev: Dict[str, Any]) -> None:
+        """Apply ONE journal event to job state — the unit shared by
+        restart replay and the hot standby's live tail (ISSUE 14). Caller
+        holds the lock (or is pre-serving __init__)."""
+        if ev.get("ev") == "submit":
+            after_order = tuple(ev.get("after") or ())
+            raw_max = ev.get("max_attempts")
+            raw_deadline = ev.get("deadline_sec")
+            self._jobs[ev["job_id"]] = Job(
+                job_id=ev["job_id"],
+                op=ev["op"],
+                payload=ev.get("payload") or {},
+                after=set(after_order),
+                after_order=after_order,
+                required_labels=ev.get("required_labels") or {},
+                max_attempts=int(raw_max) if raw_max else None,
+                # Journal schema vN+1 (ISSUE 4): scheduling fields ride
+                # the submit record only when the submitter set them, so
+                # old journals (and default submissions) replay — and
+                # re-journal — byte-identically.
+                priority=int(
+                    ev.get("priority", self.sched_config.default_priority)
+                ),
+                tenant=str(ev.get("tenant", DEFAULT_TENANT)),
+                deadline_sec=float(raw_deadline) if raw_deadline else None,
             )
-        # Jobs that were pending or in flight when the previous controller
-        # died re-queue at their CURRENT epoch — deliberately NOT bumped
-        # (ISSUE 3). Every deliberate fence (expiry/retry requeue) was
-        # journaled and already replayed above; bumping here as well would
-        # fence the *good* results agents spooled while the controller was
-        # down, re-executing finished shards on every restart. An agent
-        # whose lease straddled the restart redelivers at the same epoch
-        # and is accepted; if the job was meanwhile re-leased and completed
-        # by someone else, the terminal-state guard rejects the second
-        # application (first wins) — never applied twice either way.
+            self._depended_on.update(after_order)
+        elif ev.get("ev") == "result":
+            job = self._jobs.get(ev.get("job_id"))
+            if job is None:
+                return
+            job.state = ev.get("state", job.state)
+            job.epoch = int(ev.get("epoch", job.epoch))
+            job.attempts = int(ev.get("attempts", job.attempts))
+            job.result = ev.get("result")
+            job.error = ev.get("error")
+            if self.usage is not None and isinstance(
+                ev.get("usage"), dict
+            ):
+                # Replay-correct showback (ISSUE 9): billed usage rides
+                # the result event, so a restarted controller's
+                # /v1/usage reports the same totals the dead one did.
+                self.usage.bill(
+                    job.job_id, tenant=job.tenant, tier=job.priority,
+                    op=job.op, attempt=ev.get("attempts", 0),
+                    usage=ev["usage"],
+                )
+        elif ev.get("ev") == "requeue":
+            # Lease-expiry epoch bump: must replay, or a result the
+            # previous incarnation had fenced off could be accepted
+            # after restart (its epoch would collide with ours).
+            job = self._jobs.get(ev.get("job_id"))
+            if job is not None:
+                job.epoch = int(ev.get("epoch", job.epoch))
+
+    def _load_snapshot_state(
+        self, doc: Dict[str, Any], mirror: bool = True
+    ) -> None:
+        """Rehydrate job state from a compacting snapshot (ISSUE 14). Job
+        records are stored in insertion order, so the post-load requeue
+        step reproduces exactly the scheduler order a full-history replay
+        would have built. Results ride only for depended-on jobs — the
+        same bound the journal's result events keep."""
+        for rec in doc.get("jobs") or []:
+            after_order = tuple(rec.get("after") or ())
+            raw_max = rec.get("max_attempts")
+            raw_deadline = rec.get("deadline_sec")
+            job = Job(
+                job_id=rec["job_id"],
+                op=rec.get("op", "?"),
+                payload=rec.get("payload") or {},
+                epoch=int(rec.get("epoch", 0)),
+                state=str(rec.get("state", PENDING)),
+                attempts=int(rec.get("attempts", 0)),
+                result=rec.get("result"),
+                error=rec.get("error"),
+                after=set(after_order),
+                after_order=after_order,
+                required_labels=rec.get("required_labels") or {},
+                max_attempts=int(raw_max) if raw_max else None,
+                priority=int(
+                    rec.get("priority", self.sched_config.default_priority)
+                ),
+                tenant=str(rec.get("tenant", DEFAULT_TENANT)),
+                deadline_sec=float(raw_deadline) if raw_deadline else None,
+            )
+            self._jobs[job.job_id] = job
+            self._depended_on.update(after_order)
+        if self.usage is not None and isinstance(doc.get("usage"), dict):
+            self.usage.import_state(doc["usage"], mirror=mirror)
+
+    def _finalize_replay_locked(self) -> None:
+        """The replay→serving transition: jobs that were pending or in
+        flight when the previous controller died re-queue at their CURRENT
+        epoch — deliberately NOT bumped (ISSUE 3). Every deliberate fence
+        (expiry/retry requeue) was journaled and already replayed; bumping
+        here as well would fence the *good* results agents spooled while
+        the controller was down, re-executing finished shards on every
+        restart. An agent whose lease straddled the restart redelivers at
+        the same epoch and is accepted; if the job was meanwhile re-leased
+        and completed by someone else, the terminal-state guard rejects
+        the second application (first wins) — never applied twice either
+        way. Shared by restart replay and hot-standby promotion."""
         now = self._clock()
         for job in self._jobs.values():
             if job.state not in TERMINAL_STATES:
@@ -705,6 +760,202 @@ class Controller:
                 if job.deadline_sec is not None:
                     self._deadlined.add(job.job_id)
         self._update_queue_stats_locked(now)
+
+    def _replay_journal(self, impl: SegmentedJournal) -> None:
+        """Rebuild job state from a previous incarnation's journal:
+        snapshot (when present and valid) + uncovered segments. Runs
+        before the journal opens for append, without the lock (no other
+        thread can hold a reference yet)."""
+        t0 = time.perf_counter()
+        snap, events, stats = impl.replay()
+        if snap is not None:
+            self._load_snapshot_state(snap)
+        for ev in events:
+            self._apply_replay_event(ev)
+        stats.duration_sec = time.perf_counter() - t0
+        if stats.torn_tail:
+            self._m_journal_torn.inc(stats.torn_tail)
+            self.journal_torn_tail += stats.torn_tail
+        if stats.skipped:
+            # Mid-stream corruption is NOT a torn write: something else
+            # damaged the journal. Skipping silently would quietly
+            # resurrect or lose jobs, so count + warn (ISSUE 3 satellite).
+            self.journal_replay_skipped += stats.skipped
+            self._m_journal_skipped.inc(stats.skipped)
+            log(
+                "journal replay skipped unparseable mid-file lines",
+                path=impl.path, count=stats.skipped,
+                lines=stats.skipped_lines,
+            )
+        if stats.snapshot_invalid:
+            self._m_snapshot_invalid.inc(stats.snapshot_invalid)
+        self.journal_replay_sec = stats.duration_sec
+        self.journal_replayed_events = stats.events
+        self._m_replay_sec.set(round(stats.duration_sec, 6))
+        self._finalize_replay_locked()
+
+    # ---- snapshot / compaction (ISSUE 14) ----
+
+    def _snapshot_state_locked(self) -> Dict[str, Any]:
+        """Live state as one snapshot document: every job's replayable
+        fields (in insertion order — the order replay rebuilds the
+        scheduler from), result bodies only for depended-on jobs (the
+        journal's own bound — a snapshot must not become a second copy of
+        the drain output), and the usage ledger.
+
+        Terminal-job retention (``SNAPSHOT_RETAIN_TERMINAL``): with a
+        positive bound, only the newest N *droppable* terminal jobs ride
+        the snapshot — jobs some non-terminal job still depends on are
+        never dropped (a reduce must find its partials after a restart).
+        Restart then forgets older completed jobs; their late duplicates
+        reject as ``unknown job`` (still never re-applied), and restart
+        cost becomes O(live state + N) regardless of history length."""
+        retain = self.journal_config.snapshot_retain_terminal
+        drop: Set[str] = set()
+        if retain > 0:
+            protected: Set[str] = set()
+            for job in self._jobs.values():
+                if job.state not in TERMINAL_STATES:
+                    protected.update(job.after)
+            droppable = [
+                job.job_id for job in self._jobs.values()
+                if job.state in TERMINAL_STATES
+                and job.job_id not in protected
+            ]
+            if len(droppable) > retain:
+                drop = set(droppable[: len(droppable) - retain])
+        jobs: List[Dict[str, Any]] = []
+        for job in self._jobs.values():
+            if job.job_id in drop:
+                continue
+            rec: Dict[str, Any] = {
+                "job_id": job.job_id,
+                "op": job.op,
+                "payload": job.payload,
+                "state": job.state,
+                "epoch": job.epoch,
+                "attempts": job.attempts,
+                "error": job.error,
+                "after": list(job.after_order),
+                "required_labels": job.required_labels,
+                "max_attempts": job.max_attempts,
+                "priority": job.priority,
+                "tenant": job.tenant,
+                "deadline_sec": job.deadline_sec,
+            }
+            if job.job_id in self._depended_on:
+                rec["result"] = job.result
+            jobs.append(rec)
+        state: Dict[str, Any] = {"jobs": jobs}
+        if drop:
+            state["dropped_terminal"] = len(drop)
+        if self.usage is not None:
+            # The ledger is aggregate-bounded on its own (USAGE_MAX_JOBS)
+            # and keeps billing history for retention-dropped jobs — the
+            # (job, attempt) dedupe must survive even for forgotten jobs.
+            state["usage"] = self.usage.export_state()
+        return state
+
+    def maybe_snapshot(self, force: bool = False) -> Optional[str]:
+        """Take a compacting snapshot when the configured cadence is due
+        (``SNAPSHOT_EVERY_EVENTS`` appends since the last one). Called
+        from ``sweep()`` and the post-lease backstop; ``force=True`` is
+        the operator/test handle. The active segment rotates and the state
+        captures under the lock; the atomic write + covered-segment GC
+        run outside it. Returns the snapshot path, or None when not due
+        or snapshotting is off."""
+        impl = self._journal_impl
+        if impl is None or not impl.segmented:
+            return None
+        if not force and not impl.snapshot_every_events:
+            return None
+        with self._lock:
+            if not force and not impl.snapshot_due():
+                return None
+            through = impl.rotate_for_snapshot()
+            state = self._snapshot_state_locked()
+        path = impl.commit_snapshot(through, state)
+        self._m_snapshots.inc()
+        self.recorder.record(
+            "journal_snapshot", through_seq=through, jobs=len(state["jobs"]),
+        )
+        return path
+
+    def journal_status(self) -> Dict[str, Any]:
+        """The ``/v1/status`` ``journal`` durability block (ISSUE 14
+        satellite): replay damage + segment/snapshot/replay-cost numbers,
+        one schema whether or not a journal is configured."""
+        impl = self._journal_impl
+        file_stats = impl.stats() if impl is not None else {}
+        out = {
+            "torn_tail": self.journal_torn_tail,
+            "replay_skipped": self.journal_replay_skipped,
+            "enabled": impl is not None,
+            "segmented": bool(file_stats.get("segmented")),
+            "segments": int(file_stats.get("segments", 0)),
+            "bytes": int(file_stats.get("bytes", 0)),
+            "snapshot_bytes": int(file_stats.get("snapshot_bytes", 0)),
+            "snapshots_written": int(
+                file_stats.get("snapshots_written", 0)
+            ),
+            "last_snapshot_age_sec": file_stats.get(
+                "last_snapshot_age_sec"
+            ),
+            "last_replay_sec": round(self.journal_replay_sec, 6),
+            "replayed_events": self.journal_replayed_events,
+            "fsync": bool(file_stats.get("fsync")),
+            "promotions": self.promotions,
+        }
+        # Mirror the file-side numbers into gauges so the scrape surface
+        # tracks them too (swarmtop, tsdb sparklines).
+        if impl is not None:
+            self._m_segments.set(out["segments"])
+            self._m_journal_disk_bytes.set(out["bytes"])
+            if out["last_snapshot_age_sec"] is not None:
+                self._m_snapshot_age.set(out["last_snapshot_age_sec"])
+        return out
+
+    # ---- hot-standby surface (ISSUE 14; driven by controller/standby.py) --
+
+    def apply_snapshot_doc(
+        self, doc: Dict[str, Any], mirror: bool = True
+    ) -> None:
+        """Standby bootstrap/resync: load a snapshot into this replica
+        under the lock. A RESYNC (the primary's compaction GC'd segments
+        before the tail finished reading them) overwrites every job with
+        the snapshot's authoritative fold — convergent, since the
+        snapshot covers everything the lost segments held."""
+        with self._lock:
+            self._load_snapshot_state(doc, mirror=mirror)
+
+    def apply_journal_event(self, ev: Dict[str, Any]) -> int:
+        """Standby tail: apply one primary journal event to the warm
+        replica. Returns 1 (applied) so callers can count lag drains."""
+        with self._lock:
+            self._apply_replay_event(ev)
+        return 1
+
+    def finalize_promotion(
+        self,
+        impl: SegmentedJournal,
+        sweep_interval_sec: Optional[float] = None,
+    ) -> None:
+        """Promote this warm replica to the live controller: run the
+        replay→serving transition (non-terminal jobs requeue at their
+        current epoch — the same applied-once-or-cleanly-rejected fencing
+        a restart gets), attach the journal for append (the standby opens
+        it on a FRESH segment so a lingering primary file handle can never
+        interleave with the new incarnation's appends), and start the
+        sweeper."""
+        with self._lock:
+            self._finalize_replay_locked()
+            self._journal_impl = impl
+            self.promotions += 1
+        self._m_promotions.inc()
+        self.recorder.record("promotion", path=impl.path)
+        log("standby promoted to primary", journal=impl.path)
+        if sweep_interval_sec:
+            self.start_sweeper(sweep_interval_sec)
 
     # ---- liveness (background TTL sweeper) ----
 
@@ -727,6 +978,16 @@ class Controller:
         # Trend ring (ISSUE 9): the sweeper is the steady sampling cadence;
         # the lease path backstops it under sweeper-less tests/drains.
         self._tsdb_sample()
+        # Compaction cadence (ISSUE 14): snapshot when enough has been
+        # journaled since the last one. Outside the state lock except for
+        # the rotation + state capture inside maybe_snapshot itself. A
+        # failing snapshot write must not kill the sweeper — segments
+        # still replay, and the next cadence retries.
+        try:
+            self.maybe_snapshot()
+        except OSError as exc:
+            log("snapshot failed (segments still replay)",
+                error=str(exc)[:200])
 
     def _tsdb_sample(self) -> None:
         """Rate-limited time-series sample (controller registry + fleet
@@ -763,9 +1024,9 @@ class Controller:
             self._sweeper.join(timeout=5)
             self._sweeper = None
         with self._lock:
-            if self._journal_file is not None:
-                self._journal_file.close()
-                self._journal_file = None
+            if self._journal_impl is not None:
+                self._journal_impl.close()
+                self._journal_impl = None
 
     # ---- job submission ----
 
@@ -1227,6 +1488,14 @@ class Controller:
             # limited to TSDB_INTERVAL — one clock read per lease between
             # samples — and outside the controller lock by construction.
             self._tsdb_sample()
+            # Compaction backstop for sweeper-less drains (ISSUE 14): a
+            # cheap counter check unless a snapshot is actually due. A
+            # failing write must not fail the lease that triggered it.
+            try:
+                self.maybe_snapshot()
+            except OSError as exc:
+                log("snapshot failed (segments still replay)",
+                    error=str(exc)[:200])
 
     def _lease_impl(
         self,

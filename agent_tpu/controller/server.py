@@ -165,6 +165,14 @@ class _Handler(BaseHTTPRequestHandler):
                     job_id = self.controller.submit(
                         op=str(body["op"]),
                         payload=body.get("payload"),
+                        # Client-chosen id (ISSUE 14): a submitter that
+                        # lost the response to a dead primary resubmits
+                        # the SAME id to the standby — the duplicate-id
+                        # 400 is its exactly-once acknowledgment.
+                        job_id=(
+                            str(body["job_id"])
+                            if body.get("job_id") is not None else None
+                        ),
                         required_labels=body.get("required_labels"),
                         max_attempts=max_attempts,
                         priority=priority,
@@ -333,14 +341,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "stale_results": self.controller.stale_results,
                     "agents": self.controller.agents_summary(),
                     "summary": self.controller.status_summary(),
-                    # Journal replay damage, operator-visible (ISSUE 10
-                    # satellite): torn FINAL line (tolerated crash artifact)
-                    # counted distinctly from mid-file corruption.
-                    "journal": {
-                        "torn_tail": self.controller.journal_torn_tail,
-                        "replay_skipped":
-                            self.controller.journal_replay_skipped,
-                    },
+                    # Journal durability block (ISSUE 14 satellite): replay
+                    # damage (torn FINAL line vs mid-file corruption) plus
+                    # segment count/bytes, last-snapshot age, and the last
+                    # replay's duration — the O(live state) claim as a
+                    # number operators can read off one status call.
+                    "journal": self.controller.journal_status(),
                     "last_metrics": self.controller.last_metrics,
                 },
             )
@@ -421,6 +427,7 @@ def main() -> int:
     import signal
 
     from agent_tpu.config import (
+        JournalConfig,
         ObsConfig,
         SchedConfig,
         SloConfig,
@@ -452,6 +459,10 @@ def main() -> int:
         # USAGE_* / TSDB_* / PROFILE_* knobs (ISSUE 9): showback ledger,
         # trend ring, host profiler, on-demand deep captures.
         obs=ObsConfig.from_env(),
+        # JOURNAL_* / SNAPSHOT_* knobs (ISSUE 14): segment rotation,
+        # compacting snapshots, optional fdatasync. Defaults reproduce the
+        # historical single-file journal byte for byte.
+        journal=JournalConfig.from_env(),
     )
     server = ControllerServer(controller, host=host, port=port)
     stop = threading.Event()

@@ -197,6 +197,87 @@ class UsageLedger:
             del self._jobs[victim]
             self.evicted_jobs += 1
 
+    def export_state(self) -> Dict[str, Any]:
+        """JSON-serializable image of the ledger for the controller's
+        compacting journal snapshot (ISSUE 14): aggregates, per-job table
+        (billed-attempt sets as sorted lists), and the counters. Exact —
+        ``import_state`` rebuilds a ledger indistinguishable from one that
+        replayed the full journal."""
+        with self._lock:
+            return {
+                "by_key": [
+                    [t, tier, op, _rounded(b)]
+                    for (t, tier, op), b in self._by_key.items()
+                ],
+                "jobs": [
+                    {
+                        **{k: v for k, v in e.items() if k != "attempts"},
+                        "attempts": sorted(e["attempts"]),
+                    }
+                    for e in self._jobs.values()
+                ],
+                "billed_tasks": self.billed_tasks,
+                "evicted_jobs": self.evicted_jobs,
+            }
+
+    def import_state(
+        self, doc: Mapping[str, Any], mirror: bool = True
+    ) -> None:
+        """Rehydrate from ``export_state`` output (snapshot replay). With
+        ``mirror`` the Prometheus counters re-increment from the
+        aggregates so a snapshot-based replay exports the same totals a
+        full-journal replay would; a standby RESYNC passes ``mirror=False``
+        (its mirrors already counted the events it applied live —
+        re-incrementing would double them)."""
+        with self._lock:
+            self._by_key = {}
+            for item in doc.get("by_key") or []:
+                try:
+                    tenant, tier, op, bucket = item
+                except (TypeError, ValueError):
+                    continue
+                b = dict(_ZERO)
+                for f in _ZERO:
+                    v = (bucket or {}).get(f)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        b[f] = type(_ZERO[f])(v)
+                self._by_key[(str(tenant), int(tier), str(op))] = b
+            self._jobs = {}
+            for rec in doc.get("jobs") or []:
+                if not isinstance(rec, Mapping) or "job_id" not in rec:
+                    continue
+                entry = {
+                    "job_id": str(rec["job_id"]),
+                    "tenant": str(rec.get("tenant", "default")),
+                    "tier": int(rec.get("tier", 0)),
+                    "op": str(rec.get("op", "?")),
+                    "attempts": set(
+                        a for a in rec.get("attempts") or []
+                        if isinstance(a, int)
+                    ),
+                    **dict(_ZERO),
+                }
+                for f in _ZERO:
+                    v = rec.get(f)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        entry[f] = type(_ZERO[f])(v)
+                self._jobs[entry["job_id"]] = entry
+            self.billed_tasks = int(doc.get("billed_tasks", 0))
+            self.evicted_jobs = int(doc.get("evicted_jobs", 0))
+            by_key = dict(self._by_key)
+        if self._m_tasks is not None and mirror:
+            for (tenant, _tier, op), b in by_key.items():
+                if b["tasks"]:
+                    self._m_tasks.inc(b["tasks"], tenant=tenant, op=op)
+                if b["device_seconds"]:
+                    self._m_device.inc(
+                        b["device_seconds"], tenant=tenant, op=op
+                    )
+                if b["rows"]:
+                    self._m_rows.inc(int(b["rows"]), tenant=tenant, op=op)
+
     def job_billed_attempts(self) -> Dict[str, int]:
         """``{job_id: distinct billed attempts}`` — what the chaos soak pins
         ("retries/duplicates billed exactly once" = every value here is 1

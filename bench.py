@@ -1425,6 +1425,25 @@ def main() -> int:
     except Exception as exc:  # noqa: BLE001
         legs["csv_index"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
+    # Control-plane micro-bench (ISSUE 14): submits/sec, lease-grants/sec,
+    # and the replay-compaction speedup — no jax, pure controller. Lives
+    # in scripts/controller_bench.py so CI can run (and gate) it without
+    # paying for the model legs.
+    try:
+        sys.path.insert(
+            0,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts"),
+        )
+        import controller_bench
+
+        ctrl = controller_bench.run_bench()
+        legs["controller"] = {
+            k: v for k, v in ctrl.items() if k != "detail"
+        }
+    except Exception as exc:  # noqa: BLE001
+        legs["controller"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+
     try:
         classify_drain, mixed_drain = _bench_drain(runtime)
         legs["drain"] = classify_drain
@@ -1560,6 +1579,21 @@ def main() -> int:
                 "usage_device_seconds": legs.get("drain_mixed", {})
                 .get("usage_device_seconds"),
                 "usage_rows": legs.get("drain_mixed", {}).get("usage_rows"),
+                # Control-plane flat fields (ISSUE 14): the controller
+                # ceiling as tracked numbers — submit/lease throughput and
+                # the snapshot-compaction replay speedup.
+                "controller_submits_per_sec": legs["controller"]
+                .get("submits_per_sec"),
+                "controller_lease_grants_per_sec": legs["controller"]
+                .get("lease_grants_per_sec"),
+                "controller_tasks_leased_per_sec": legs["controller"]
+                .get("tasks_leased_per_sec"),
+                "controller_replay_events_per_sec": legs["controller"]
+                .get("replay_events_per_sec"),
+                "controller_replay_compacted_sec": legs["controller"]
+                .get("replay_compacted_sec"),
+                "controller_replay_speedup": legs["controller"]
+                .get("replay_speedup"),
             }
         ),
         flush=True,

@@ -47,6 +47,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOWER_BETTER = {
     "classify_p50_batch_ms",
     "wire_bytes_per_row",
+    "controller_replay_compacted_sec",
 }
 
 # Fields that are identity/config, not performance — never judged.

@@ -1,0 +1,731 @@
+#!/usr/bin/env python3
+"""Controller crash-survival soak — the chaos drill that finally attacks
+the control plane itself (ISSUE 14; ROADMAP item 3c).
+
+Two runs per seed:
+
+1. **Calm reference** — an in-process ``Controller`` + ``LoopbackSession``
+   agents drain the identical seeded workload (bulk risk_accumulate
+   map-reduce + seeded echo singles). Records the canonical reduce result.
+2. **Failover run** — the primary controller is a REAL subprocess
+   (``python -m agent_tpu.controller.server``) journaling to a segmented
+   journal with compacting snapshots; a ``HotStandby`` tails the journal
+   in-process; real ``Agent`` threads lease/post over real HTTP with a
+   ``CONTROLLER_URLS`` failover list. Mid-drain, under seeded load, the
+   chaos plan's ``controller_kill`` draw SIGKILLs the primary — no
+   close(), no fsync, a possibly-torn final journal line. The standby
+   promotes (final tail + seal + epoch-fenced requeue) and serves on the
+   pre-agreed standby port; agents fail over; the spool redelivers
+   completed results to the new incarnation; a submitter keeps submitting
+   singles across the flip with deterministic job ids (a duplicate-id 400
+   after a lost response = already submitted = success).
+
+Asserts (the ISSUE 14 acceptance bar):
+
+- the failover run's reduce result is **bit-identical** to the calm
+  reference;
+- **zero lost / double-applied / double-billed jobs**: every job terminal
+  ``succeeded``, ledger ``billed == jobs``, every job billed exactly once;
+- **≥ 1 controller kill** actually happened (seeded, with a deterministic
+  force-by-deadline backstop), every agent **failed over** (counter ≥ 1),
+  the standby **promoted exactly once**, and ≥ 1 compacting **snapshot**
+  landed during the run;
+- after the drain the **journal replays** into a fresh controller with
+  identical job states/epochs/attempts, an identical usage ledger, an
+  empty scheduler queue, and **zero torn/skipped lines** (promotion sealed
+  the primary's torn death write);
+- the promoted incarnation's ``/v1/status`` ``journal`` block rides real
+  HTTP with ``promotions: 1``.
+
+Exit 0 = all seeds clean; 1 = problems (listed one per line). CI runs
+``--quick --seed 7`` (CPU-shaped, < 90 s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from agent_tpu.agent.app import Agent
+from agent_tpu.chaos import FaultPlan, LoopbackSession
+from agent_tpu.config import AgentConfig, Config, JournalConfig
+from agent_tpu.controller.core import Controller
+from agent_tpu.controller.journal import list_segments, load_snapshot
+from agent_tpu.controller.server import ControllerServer
+from agent_tpu.controller.standby import HotStandby
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Timing/attribution fields legitimately differ run to run; everything
+# else in the reduce result must match bit for bit (same exclusion set as
+# chaos_soak / elastic_soak).
+VOLATILE_KEYS = ("compute_time_ms", "duration_ms", "timings", "trace",
+                 "usage")
+
+JOURNAL_CFG = JournalConfig(
+    segment_max_bytes=8 * 1024, snapshot_every_events=30
+)
+
+# The throttled map op ships through the designed extension point
+# (OPS_PLUGIN_PATH / load_plugins), not a registry monkey-patch: a
+# payload-controlled service time is what keeps the drain IN FLIGHT long
+# enough for the seeded controller_kill to land mid-drain on a CPU
+# runner. It returns risk_accumulate's result unchanged, so the reduce
+# stays bit-identical to the calm reference.
+PLUGIN_SRC = '''\
+"""Soak-only op: risk_accumulate with payload-controlled service time."""
+import time
+
+from agent_tpu.ops import register_op
+from agent_tpu.ops.risk_accumulate import run as _risk
+
+
+@register_op("slow_risk")
+def run(payload, ctx=None):
+    out = _risk(payload, ctx)
+    time.sleep(float(payload.get("sleep_ms", 0.0)) / 1e3)
+    return out
+'''
+
+
+def canonical(result: Any) -> str:
+    if isinstance(result, dict):
+        result = {k: v for k, v in result.items() if k not in VOLATILE_KEYS}
+    return json.dumps(result, sort_keys=True, default=str)
+
+
+def build_csv(path: str, rows: int) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("id,text,risk\n")
+        for i in range(rows):
+            f.write(f'{i},"record {i}",{(i % 17) * 0.25}\n')
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def http_json(
+    url: str, body: Optional[Dict[str, Any]] = None, timeout: float = 5.0
+) -> Tuple[int, Any]:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            return resp.status, (json.loads(raw) if raw else None)
+    except urllib.error.HTTPError as exc:
+        raw = exc.read()
+        try:
+            return exc.code, json.loads(raw) if raw else None
+        except ValueError:
+            return exc.code, raw.decode(errors="replace")
+
+
+def wait_for_status(url: str, deadline_sec: float) -> bool:
+    deadline = time.monotonic() + deadline_sec
+    while time.monotonic() < deadline:
+        try:
+            status, _ = http_json(url + "/v1/status", timeout=2)
+            if status == 200:
+                return True
+        except Exception:  # noqa: BLE001 — still booting
+            pass
+        time.sleep(0.05)
+    return False
+
+
+def make_agent(name: str, urls: List[str]) -> Agent:
+    cfg = Config(agent=AgentConfig(
+        controller_url=urls[0], controller_urls=tuple(urls),
+        agent_name=name, tasks=("slow_risk", "risk_accumulate", "echo"),
+        max_tasks=2, idle_sleep_sec=0.02, http_timeout_sec=5.0,
+        error_backoff_sec=0.05, retry_base_sec=0.02, retry_max_sec=0.25,
+        pipeline_depth=0,
+    ))
+    agent = Agent(config=cfg)
+    agent._profile = {"tier": "failover-soak"}  # skip hardware probing
+    return agent
+
+
+def submit_bulk_http(
+    url: str, csv_path: str, shards: int, rows_per_shard: int,
+    sleep_ms: float,
+) -> Tuple[List[str], str]:
+    status, body = http_json(url + "/v1/jobs", {
+        "source_uri": csv_path,
+        "total_rows": shards * rows_per_shard,
+        "shard_size": rows_per_shard,
+        "map_op": "slow_risk",
+        "extra_payload": {"field": "risk", "sleep_ms": sleep_ms},
+        "reduce_op": "risk_accumulate",
+        "collect_partials": True,
+    })
+    if status != 200:
+        raise RuntimeError(f"bulk submit failed: HTTP {status} {body}")
+    return body["job_ids"], body["reduce_id"]
+
+
+class SingleSubmitter:
+    """Paced seeded echo singles with deterministic job ids, submitted
+    across the failover flip: each id retries round-robin over the URL
+    list until accepted — a duplicate-id 400 after a lost response means
+    the dead primary already journaled it, which is success."""
+
+    def __init__(self, urls: List[str], seed: int, n: int,
+                 window_sec: float) -> None:
+        self.urls = urls
+        self.seed = seed
+        self.n = n
+        self.window_sec = window_sec
+        self.submitted: List[str] = []
+        self.duplicate_acks = 0
+        self._thread = threading.Thread(
+            target=self._run, name="soak-submitter", daemon=True
+        )
+
+    def _submit_one(self, i: int) -> Optional[str]:
+        job_id = f"single-{self.seed}-{i}"
+        body = {"op": "echo", "payload": {"seq": i, "seed": self.seed},
+                "job_id": job_id}
+        deadline = time.monotonic() + 30.0
+        k = 0
+        while time.monotonic() < deadline:
+            url = self.urls[k % len(self.urls)]
+            k += 1
+            try:
+                status, resp = http_json(
+                    url + "/v1/jobs", body, timeout=3
+                )
+            except Exception:  # noqa: BLE001 — controller down: rotate
+                time.sleep(0.05)
+                continue
+            if status == 200:
+                return job_id
+            if status == 400 and "duplicate job id" in str(resp):
+                self.duplicate_acks += 1
+                return job_id
+            time.sleep(0.05)
+        return None
+
+    def _run(self) -> None:
+        gap = self.window_sec / max(1, self.n)
+        for i in range(self.n):
+            jid = self._submit_one(i)
+            if jid is not None:
+                self.submitted.append(jid)
+            time.sleep(gap)
+
+    def start(self) -> "SingleSubmitter":
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float) -> None:
+        self._thread.join(timeout=timeout)
+
+
+def run_reference(
+    tmp: str, csv_path: str, shards: int, rows_per_shard: int, seed: int,
+    args: Any,
+) -> Tuple[Dict[str, Any], List[str]]:
+    """Calm in-process drain of the identical workload — the bit-identity
+    anchor."""
+    problems: List[str] = []
+    out: Dict[str, Any] = {}
+    controller = Controller(
+        lease_ttl_sec=10.0, max_attempts=10, requeue_delay_sec=0.01,
+        sweep_interval_sec=0.1,
+    )
+    agents = [
+        Agent(
+            config=Config(agent=AgentConfig(
+                controller_url="http://loopback", agent_name=f"ref-{i}",
+                tasks=("slow_risk", "risk_accumulate", "echo"),
+                max_tasks=2,
+                idle_sleep_sec=0.01, error_backoff_sec=0.01,
+                retry_base_sec=0.005, retry_max_sec=0.05, pipeline_depth=0,
+            )),
+            session=LoopbackSession(controller),
+        )
+        for i in range(2)
+    ]
+    for a in agents:
+        a._profile = {"tier": "failover-soak"}
+    threads = [
+        threading.Thread(target=a.run, name=f"ref-agent-{i}", daemon=True)
+        for i, a in enumerate(agents)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        _, reduce_id = controller.submit_csv_job(
+            csv_path, total_rows=shards * rows_per_shard,
+            shard_size=rows_per_shard, map_op="slow_risk",
+            extra_payload={"field": "risk", "sleep_ms": args.sleep_ms},
+            reduce_op="risk_accumulate",
+            collect_partials=True,
+        )
+        for i in range(args.singles):
+            controller.submit(
+                "echo", {"seq": i, "seed": seed},
+                job_id=f"single-{seed}-{i}",
+            )
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and not controller.drained():
+            time.sleep(0.05)
+        if not controller.drained():
+            problems.append(
+                f"seed {seed}: reference drain did not complete "
+                f"(counts {controller.counts()})"
+            )
+            return out, problems
+        job = controller.job_snapshot(reduce_id)
+        if job["state"] != "succeeded":
+            problems.append(
+                f"seed {seed}: reference reduce state {job['state']!r}"
+            )
+            return out, problems
+        out["reduce"] = canonical(job["result"])
+    finally:
+        for a in agents:
+            a.request_drain(reason="reference done")
+        for t in threads:
+            t.join(timeout=10)
+        controller.close()
+    return out, problems
+
+
+def run_failover(
+    tmp: str, csv_path: str, shards: int, rows_per_shard: int, seed: int,
+    args: Any, reference: Dict[str, Any],
+) -> List[str]:
+    problems: List[str] = []
+    journal_path = os.path.join(tmp, "controller_journal.jsonl")
+    port_a, port_b = free_port(), free_port()
+    url_a = f"http://127.0.0.1:{port_a}"
+    url_b = f"http://127.0.0.1:{port_b}"
+    urls = [url_a, url_b]
+    plan = FaultPlan(seed=seed, controller_kill=args.kill_prob)
+
+    env = dict(os.environ)
+    env.update(
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        CONTROLLER_HOST="127.0.0.1",
+        CONTROLLER_PORT=str(port_a),
+        CONTROLLER_JOURNAL=journal_path,
+        JOURNAL_SEGMENT_MAX_BYTES=str(JOURNAL_CFG.segment_max_bytes),
+        SNAPSHOT_EVERY_EVENTS=str(JOURNAL_CFG.snapshot_every_events),
+        LEASE_TTL_SEC="3",
+        MAX_ATTEMPTS="10",
+        REQUEUE_DELAY_SEC="0.01",
+        CONTROLLER_SWEEP_SEC="0.2",
+    )
+    primary = subprocess.Popen(
+        [sys.executable, "-m", "agent_tpu.controller.server"],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    standby: Optional[HotStandby] = None
+    standby_server: Optional[ControllerServer] = None
+    promoted: Optional[Controller] = None
+    agents: List[Agent] = []
+    threads: List[threading.Thread] = []
+    kills = 0
+    succeeded_at_kill = 0
+    try:
+        if not wait_for_status(url_a, 20.0):
+            problems.append(f"seed {seed}: primary never became healthy")
+            return problems
+        standby = HotStandby(
+            journal_path, journal=JOURNAL_CFG, poll_interval_sec=0.02,
+            sweep_interval_sec=0.2, lease_ttl_sec=3.0, max_attempts=10,
+            requeue_delay_sec=0.01,
+        ).start()
+
+        agents = [
+            make_agent(f"fo-{seed}-{i}", urls) for i in range(args.agents)
+        ]
+        threads = [
+            threading.Thread(target=a.run, name=f"fo-agent-{i}",
+                             daemon=True)
+            for i, a in enumerate(agents)
+        ]
+        for t in threads:
+            t.start()
+
+        shard_ids, reduce_id = submit_bulk_http(
+            url_a, csv_path, shards, rows_per_shard, args.sleep_ms
+        )
+        submitter = SingleSubmitter(
+            urls, seed, args.singles, args.submit_window_sec
+        ).start()
+
+        # ---- the drill: seeded controller_kill once enough is in flight
+        kill_floor = max(1, int(shards * args.kill_after_frac))
+        force_deadline = time.monotonic() + args.kill_deadline_sec
+        while kills == 0:
+            try:
+                status, body = http_json(url_a + "/v1/status", timeout=2)
+                by_op = (body or {}).get("counts_by_op", {})
+                shards_done = by_op.get("slow_risk", {}).get(
+                    "succeeded", 0
+                )
+            except Exception:  # noqa: BLE001 — primary gone early?
+                problems.append(
+                    f"seed {seed}: primary unreachable before the kill"
+                )
+                break
+            # Armed once the drain is genuinely IN FLIGHT (shard
+            # successes, not singles — the mid-drain guarantee); forced
+            # once the window starts closing or the deadline passes, so
+            # the drill happens even when the seeded draws come up short.
+            armed = shards_done >= kill_floor
+            forced = (
+                time.monotonic() > force_deadline
+                or shards_done >= max(kill_floor + 1, int(shards * 0.6))
+            )
+            if armed and (plan.decide("controller_kill") or forced):
+                primary.send_signal(signal.SIGKILL)
+                primary.wait(timeout=10)
+                kills += 1
+                succeeded_at_kill = shards_done
+                if forced and not plan.counts.get("controller_kill"):
+                    # Deterministic backstop, still counted as the fault.
+                    plan.counts["controller_kill"] = \
+                        plan.counts.get("controller_kill", 0) + 1
+                break
+            time.sleep(0.05)
+        if kills == 0:
+            return problems
+        if succeeded_at_kill >= shards:
+            problems.append(
+                f"seed {seed}: kill landed too late "
+                f"({succeeded_at_kill} >= {shards} shards done) — not a "
+                "mid-drain drill; raise --sleep-ms"
+            )
+
+        # ---- promotion: the standby becomes the controller on url_b
+        promoted = standby.promote()
+        standby_server = ControllerServer(
+            promoted, host="127.0.0.1", port=port_b
+        ).start()
+
+        submitter.join(timeout=args.submit_window_sec + 60.0)
+        expected = set(shard_ids) | {reduce_id} | set(submitter.submitted)
+        n_jobs = len(expected)
+        if len(submitter.submitted) != args.singles:
+            problems.append(
+                f"seed {seed}: only {len(submitter.submitted)}/"
+                f"{args.singles} singles submitted across the flip"
+            )
+
+        deadline = time.monotonic() + args.deadline_sec
+        while time.monotonic() < deadline and not promoted.drained():
+            time.sleep(0.05)
+        if not promoted.drained():
+            problems.append(
+                f"seed {seed}: failover drain did not complete "
+                f"(counts {promoted.counts()})"
+            )
+            return problems
+
+        # ---- zero lost work, bit-identical output ----
+        counts = promoted.counts()
+        if counts.get("failed") or counts.get("dead"):
+            problems.append(
+                f"seed {seed}: failed/dead jobs after failover: {counts}"
+            )
+        if counts.get("succeeded", 0) != n_jobs:
+            problems.append(
+                f"seed {seed}: {counts.get('succeeded', 0)} succeeded != "
+                f"{n_jobs} submitted (lost work)"
+            )
+        for jid in expected:
+            try:
+                snap = promoted.job_snapshot(jid)
+            except KeyError:
+                problems.append(
+                    f"seed {seed}: job {jid} lost across the flip"
+                )
+                continue
+            if snap["state"] != "succeeded":
+                problems.append(
+                    f"seed {seed}: job {jid} state {snap['state']!r}"
+                )
+        reduce_job = promoted.job_snapshot(reduce_id)
+        got = canonical(reduce_job["result"])
+        if got != reference.get("reduce"):
+            problems.append(
+                f"seed {seed}: reduce diverged across the flip\n"
+                f"  want {reference.get('reduce')}\n  got  {got}"
+            )
+
+        # ---- zero double-billing (double-application would show here) --
+        if promoted.usage is None:
+            problems.append(f"seed {seed}: usage ledger disabled")
+        else:
+            billed = promoted.usage.billed_tasks
+            if billed != n_jobs:
+                problems.append(
+                    f"seed {seed}: usage billed {billed} != jobs {n_jobs} "
+                    "(lost or double-billed work)"
+                )
+            multi = {
+                jid: n
+                for jid, n in promoted.usage.job_billed_attempts().items()
+                if n != 1
+            }
+            if multi:
+                problems.append(
+                    f"seed {seed}: jobs billed != once: "
+                    f"{dict(list(multi.items())[:5])}"
+                )
+
+        # ---- the failover machinery actually engaged ----
+        failovers = 0
+        for a in agents:
+            snap = a.obs.snapshot()
+            for s in snap.get("controller_failovers_total", {}).get(
+                "series", []
+            ):
+                failovers += int(s.get("value", 0))
+        if failovers < args.agents:
+            problems.append(
+                f"seed {seed}: only {failovers} agent failovers "
+                f"(expected >= {args.agents} — every agent must rotate)"
+            )
+        if promoted.promotions != 1:
+            problems.append(
+                f"seed {seed}: promotions {promoted.promotions} != 1"
+            )
+        if load_snapshot(journal_path) is None:
+            problems.append(
+                f"seed {seed}: no compacting snapshot landed during the "
+                "run (SNAPSHOT_EVERY_EVENTS never fired?)"
+            )
+        n_segments = len(list_segments(journal_path))
+        if n_segments > 200:
+            problems.append(
+                f"seed {seed}: {n_segments} journal segments on disk — "
+                "compaction is not collecting covered segments"
+            )
+
+        # ---- the promoted /v1/status journal block over real HTTP ----
+        status, body = http_json(url_b + "/v1/status", timeout=3)
+        jblock = (body or {}).get("journal", {})
+        if status != 200 or jblock.get("promotions") != 1:
+            problems.append(
+                f"seed {seed}: standby /v1/status journal block wrong: "
+                f"HTTP {status} {jblock}"
+            )
+        for key in ("segments", "bytes", "last_snapshot_age_sec",
+                    "last_replay_sec"):
+            if key not in jblock:
+                problems.append(
+                    f"seed {seed}: journal status block missing {key!r}"
+                )
+
+        # ---- retire the fleet through the drain path ----
+        for a in agents:
+            a.request_drain(reason="soak done")
+        for t in threads:
+            t.join(timeout=15)
+        leftover = [len(a.spool) for a in agents if len(a.spool)]
+        if leftover:
+            problems.append(
+                f"seed {seed}: agents left spooled results: {leftover}"
+            )
+
+        # ---- the healed journal replays to the identical state ----
+        live = {}
+        for jid in expected:
+            try:
+                live[jid] = promoted.job_snapshot(jid)
+            except KeyError:
+                pass  # already recorded as lost above
+        live_billed = promoted.usage.billed_tasks \
+            if promoted.usage is not None else 0
+        live_attempts = promoted.usage.job_billed_attempts() \
+            if promoted.usage is not None else {}
+        standby_server.stop()
+        standby_server = None
+        promoted.close()
+        replayed = Controller(journal_path=journal_path, journal=JOURNAL_CFG)
+        try:
+            if replayed.journal_torn_tail or replayed.journal_replay_skipped:
+                problems.append(
+                    f"seed {seed}: journal replay damage after the flip "
+                    f"(torn {replayed.journal_torn_tail}, skipped "
+                    f"{replayed.journal_replay_skipped}) — promotion "
+                    "failed to seal the torn tail"
+                )
+            if replayed.queue_depth() != 0:
+                problems.append(
+                    f"seed {seed}: replayed queue depth "
+                    f"{replayed.queue_depth()} != 0"
+                )
+            for jid, want in live.items():
+                try:
+                    got_snap = replayed.job_snapshot(jid)
+                except KeyError:
+                    problems.append(
+                        f"seed {seed}: job {jid} lost in final replay"
+                    )
+                    continue
+                for k in ("state", "job_epoch", "attempts"):
+                    if got_snap[k] != want[k]:
+                        problems.append(
+                            f"seed {seed}: replay {jid} {k} "
+                            f"{got_snap[k]!r} != live {want[k]!r}"
+                        )
+                        break
+            if replayed.usage is not None:
+                if replayed.usage.billed_tasks != live_billed:
+                    problems.append(
+                        f"seed {seed}: replayed ledger billed "
+                        f"{replayed.usage.billed_tasks} != live "
+                        f"{live_billed}"
+                    )
+                if replayed.usage.job_billed_attempts() != live_attempts:
+                    problems.append(
+                        f"seed {seed}: replayed per-job billing diverged"
+                    )
+        finally:
+            replayed.close()
+        promoted = None
+
+        print(json.dumps({
+            "scenario": "controller_failover", "seed": seed,
+            "jobs": n_jobs, "singles": len(submitter.submitted),
+            "duplicate_acks": submitter.duplicate_acks,
+            "controller_kills": kills,
+            "plan_counts": plan.counts,
+            "agent_failovers": failovers,
+            "torn_sealed_bytes": standby.torn_sealed_bytes,
+            "snapshots": jblock.get("snapshots_written"),
+            "segments": n_segments,
+            "counts": counts, "ok": not problems,
+        }, sort_keys=True))
+        return problems
+    finally:
+        for a in agents:
+            a.request_drain(reason="cleanup")
+        for t in threads:
+            t.join(timeout=10)
+        if standby is not None:
+            standby.stop()
+        if standby_server is not None:
+            standby_server.stop()
+        if promoted is not None:
+            promoted.close()
+        if primary.poll() is None:
+            primary.kill()
+            primary.wait(timeout=10)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--seeds", type=str, default="",
+                    help="comma-separated seed list (overrides --seed)")
+    ap.add_argument("--shards", type=int, default=24)
+    ap.add_argument("--rows-per-shard", type=int, default=40)
+    ap.add_argument("--singles", type=int, default=40,
+                    help="seeded echo singles submitted across the flip")
+    ap.add_argument("--agents", type=int, default=3)
+    ap.add_argument("--kill-prob", type=float, default=0.35,
+                    help="per-tick controller_kill probability once armed")
+    ap.add_argument("--kill-after-frac", type=float, default=0.25,
+                    help="arm the kill once this fraction of shards "
+                         "succeeded (mid-drain, not at the edges)")
+    ap.add_argument("--kill-deadline-sec", type=float, default=25.0,
+                    help="force the kill by this deadline if the seeded "
+                         "draws came up short")
+    ap.add_argument("--submit-window-sec", type=float, default=6.0)
+    ap.add_argument("--sleep-ms", type=float, default=120.0,
+                    help="per-shard service time — what keeps the drain "
+                         "in flight long enough to kill mid-drain")
+    ap.add_argument("--deadline-sec", type=float, default=90.0)
+    ap.add_argument("--quick", action="store_true",
+                    help="CI sizing: shrinks the workload for < 90 s")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        args.shards = min(args.shards, 16)
+        args.rows_per_shard = min(args.rows_per_shard, 30)
+        args.singles = min(args.singles, 24)
+        args.submit_window_sec = min(args.submit_window_sec, 4.0)
+        args.deadline_sec = min(args.deadline_sec, 60.0)
+
+    seeds = (
+        [int(s) for s in args.seeds.split(",") if s.strip()]
+        if args.seeds else [args.seed]
+    )
+
+    # The throttled map op, loaded through the designed plugin channel.
+    tmp_root = tempfile.mkdtemp(prefix="failover_soak_plugin_")
+    plugin_path = os.path.join(tmp_root, "slow_risk_plugin.py")
+    with open(plugin_path, "w", encoding="utf-8") as f:
+        f.write(PLUGIN_SRC)
+    from agent_tpu.ops import load_plugins
+
+    if "slow_risk" not in load_plugins(plugin_path):
+        from agent_tpu.ops import OPS_LOAD_ERRORS
+
+        print(f"slow_risk plugin failed to load: {OPS_LOAD_ERRORS}")
+        return 1
+
+    problems: List[str] = []
+    t0 = time.monotonic()
+    for seed in seeds:
+        with tempfile.TemporaryDirectory(
+            prefix=f"failover_soak_{seed}_"
+        ) as tmp:
+            csv_path = os.path.join(tmp, "rows.csv")
+            build_csv(csv_path, args.shards * args.rows_per_shard)
+            reference, ref_problems = run_reference(
+                tmp, csv_path, args.shards, args.rows_per_shard, seed,
+                args,
+            )
+            problems += ref_problems
+            if not ref_problems:
+                problems += run_failover(
+                    tmp, csv_path, args.shards, args.rows_per_shard,
+                    seed, args, reference,
+                )
+    elapsed = round(time.monotonic() - t0, 3)
+    if problems:
+        for p in problems:
+            print(p)
+        print(f"FAILED: {len(problems)} problem(s) in {elapsed}s")
+        return 1
+    print(
+        f"controller failover soak: OK ({len(seeds)} seed(s), "
+        f"{args.shards} shards, {elapsed}s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

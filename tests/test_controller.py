@@ -362,8 +362,19 @@ def test_status_endpoint_schema():
         "counts", "counts_by_op", "queue_depth", "drained", "stale_results",
         "agents", "summary", "journal", "last_metrics",
     }
-    # ISSUE 10 satellite: journal replay damage is operator-visible.
-    assert body["journal"] == {"torn_tail": 0, "replay_skipped": 0}
+    # ISSUE 14 satellite: the journal durability block — replay damage
+    # (ISSUE 10) plus segment/snapshot/replay-cost numbers, one schema
+    # whether or not a journal is configured (enabled=False here).
+    assert set(body["journal"]) == {
+        "torn_tail", "replay_skipped", "enabled", "segmented", "segments",
+        "bytes", "snapshot_bytes", "snapshots_written",
+        "last_snapshot_age_sec", "last_replay_sec", "replayed_events",
+        "fsync", "promotions",
+    }
+    assert body["journal"]["torn_tail"] == 0
+    assert body["journal"]["replay_skipped"] == 0
+    assert body["journal"]["enabled"] is False
+    assert body["journal"]["segments"] == 0
     assert body["agents"]["a1"]["draining"] is False
     assert body["counts"] == {"succeeded": 1, "pending": 2}
     assert body["counts_by_op"] == {
@@ -574,7 +585,15 @@ class TestJournalStatusCounters:
         with ControllerServer(replayed) as srv:
             with urllib.request.urlopen(srv.url + "/v1/status") as r:
                 body = _json.loads(r.read())
-        assert body["journal"] == {"torn_tail": 1, "replay_skipped": 1}
+        assert body["journal"]["torn_tail"] == 1
+        assert body["journal"]["replay_skipped"] == 1
+        # ISSUE 14: the durability block rides alongside the damage
+        # counters — a live journal reports its file-side numbers.
+        assert body["journal"]["enabled"] is True
+        assert body["journal"]["segments"] == 1
+        assert body["journal"]["bytes"] > 0
+        assert body["journal"]["replayed_events"] == 2  # j-keep + j2
+        assert body["journal"]["last_replay_sec"] >= 0
         replayed.close()
 
     def test_clean_journal_reports_zero(self, tmp_path):

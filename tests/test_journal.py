@@ -1,0 +1,697 @@
+"""Journal segmentation/snapshot/compaction, hot-standby failover, and the
+agent-side CONTROLLER_URLS rotation (ISSUE 14)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from agent_tpu.agent.app import Agent
+from agent_tpu.chaos import ChaosTransportError, LoopbackSession
+from agent_tpu.config import AgentConfig, Config, JournalConfig
+from agent_tpu.controller.core import Controller
+from agent_tpu.controller.journal import (
+    JournalTailer,
+    SegmentedJournal,
+    list_segments,
+    load_snapshot,
+    segment_path,
+)
+from agent_tpu.controller.standby import HotStandby
+from agent_tpu.obs.usage import UsageLedger
+
+SEG_CFG = JournalConfig(segment_max_bytes=400)
+SNAP_CFG = JournalConfig(segment_max_bytes=400, snapshot_every_events=8)
+
+
+def drain_n(c, n, agent="a", ops=("echo",)):
+    done = []
+    for _ in range(n):
+        lease = c.lease(agent, {"ops": list(ops)})
+        t = lease["tasks"][0]
+        c.report(lease["lease_id"], t["id"], t["job_epoch"], "succeeded",
+                 {"ok": True})
+        done.append(t["id"])
+    return done
+
+
+def snapshot_of(c, ids):
+    return {j: c.job_snapshot(j) for j in ids}
+
+
+def states_equal(a, b):
+    for jid, live in a.items():
+        re = b[jid]
+        for k in ("state", "job_epoch", "attempts"):
+            assert re[k] == live[k], (jid, k, live[k], re[k])
+
+
+class TestSegmentation:
+    def test_default_config_stays_single_file(self, tmp_path):
+        """Byte-compat: a default JournalConfig is the historical single
+        append-only file — no segments, no snapshot, same bytes."""
+        path = str(tmp_path / "j.jsonl")
+        c = Controller(journal_path=path)
+        c.submit("echo", {"x": 1}, job_id="j1")
+        c.close()
+        assert os.path.exists(path)
+        assert list_segments(path) == []
+        assert not os.path.exists(path + ".snapshot")
+        (line,) = open(path, encoding="utf-8").read().splitlines()
+        assert json.loads(line) == {
+            "ev": "submit", "job_id": "j1", "op": "echo",
+            "payload": {"x": 1}, "after": [], "required_labels": {},
+            "max_attempts": None,
+        }
+
+    def test_rotation_bounds_segments(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        c = Controller(journal_path=path, journal=SEG_CFG)
+        ids = [c.submit("echo", {"i": i}) for i in range(30)]
+        c.close()
+        segs = list_segments(path)
+        assert len(segs) > 1
+        for _seq, seg in segs[:-1]:
+            # Every sealed segment respects the budget (within one event).
+            assert os.path.getsize(seg) <= SEG_CFG.segment_max_bytes + 200
+        # The full chain replays every submit.
+        c2 = Controller(journal_path=path, journal=SEG_CFG)
+        assert c2.counts() == {"pending": 30}
+        assert {t["id"] for t in c2.lease(
+            "a", {"ops": ["echo"]}, max_tasks=30)["tasks"]} == set(ids)
+        c2.close()
+
+    def test_event_budget_rotation(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        cfg = JournalConfig(segment_max_events=5)
+        c = Controller(journal_path=path, journal=cfg)
+        for i in range(12):
+            c.submit("echo", {"i": i})
+        c.close()
+        assert len(list_segments(path)) == 3  # 5 + 5 + 2
+
+    def test_legacy_file_replays_before_segments(self, tmp_path):
+        """An operator flipping segmentation on mid-life: the old single
+        file replays first, then the new segments."""
+        path = str(tmp_path / "j.jsonl")
+        c = Controller(journal_path=path)
+        c.submit("echo", {}, job_id="old")
+        c.close()
+        c2 = Controller(journal_path=path, journal=SEG_CFG)
+        c2.submit("echo", {}, job_id="new")
+        c2.close()
+        c3 = Controller(journal_path=path, journal=SEG_CFG)
+        assert set(j for j in c3._jobs) == {"old", "new"}
+        c3.close()
+
+
+class TestSnapshot:
+    def test_snapshot_compacts_and_replays_identically(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        c = Controller(journal_path=path, journal=SNAP_CFG)
+        shard_ids, reduce_id = c.submit_csv_job(
+            "d.csv", total_rows=400, shard_size=100,
+            reduce_op="risk_accumulate", collect_partials=True,
+        )
+        drain_n(c, 2, ops=("read_csv_shard",))
+        c.maybe_snapshot(force=True)
+        drain_n(c, 1, ops=("read_csv_shard",))
+        live = snapshot_of(c, shard_ids + [reduce_id])
+        c.close()
+
+        snap = load_snapshot(path)
+        assert snap is not None and snap["version"] == 1
+        # GC: every covered segment is gone.
+        assert all(s > snap["through_seq"] for s, _ in list_segments(path))
+
+        c2 = Controller(journal_path=path, journal=SNAP_CFG)
+        states_equal(live, snapshot_of(c2, shard_ids + [reduce_id]))
+        # Depended-on result bodies survive the snapshot: the reduce still
+        # materializes ordered partials.
+        drain_n(c2, 1, ops=("read_csv_shard",))
+        lease = c2.lease("a", {"ops": ["risk_accumulate"]})
+        partials = lease["tasks"][0]["payload"]["partials"]
+        assert [p["ok"] for p in partials] == [True] * 4
+        c2.close()
+
+    def test_snapshot_cadence_fires_automatically(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        c = Controller(journal_path=path, journal=SNAP_CFG)
+        for i in range(20):  # > snapshot_every_events appends
+            c.submit("echo", {"i": i})
+        c.sweep()  # the sweeper cadence drives maybe_snapshot()
+        assert os.path.exists(path + ".snapshot")
+        assert c.journal_status()["snapshots_written"] >= 1
+        c.close()
+
+    def test_snapshot_write_is_atomic_tmp_rename(self, tmp_path,
+                                                 monkeypatch):
+        """Kill-the-writer-mid-snapshot regression (ISSUE 14 satellite):
+        death before the rename leaves the PREVIOUS snapshot (or none)
+        intact — never a half image — and replay falls back to segments."""
+        path = str(tmp_path / "j.jsonl")
+        # Force-only cadence: no automatic snapshot may land first.
+        c = Controller(journal_path=path, journal=SEG_CFG)
+        ids = [c.submit("echo", {"i": i}) for i in range(10)]
+        drain_n(c, 4)
+        live = snapshot_of(c, ids)
+
+        real_replace = os.replace
+
+        def die_before_rename(src, dst):
+            raise OSError("chaos: writer killed mid-snapshot")
+
+        monkeypatch.setattr(os, "replace", die_before_rename)
+        with pytest.raises(OSError):
+            c.maybe_snapshot(force=True)
+        monkeypatch.setattr(os, "replace", real_replace)
+        c.close()
+        # No snapshot landed; the half-written tmp is cleaned up; replay
+        # rebuilds the identical state from segments alone.
+        assert not os.path.exists(path + ".snapshot")
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+        c2 = Controller(journal_path=path, journal=SNAP_CFG)
+        states_equal(live, snapshot_of(c2, ids))
+        assert c2.counts() == {"succeeded": 4, "pending": 6}
+        c2.close()
+
+    def test_half_written_snapshot_ignored(self, tmp_path):
+        """A corrupt/truncated snapshot file (external damage, version
+        skew) is IGNORED in favor of full-segment replay, counted."""
+        path = str(tmp_path / "j.jsonl")
+        c = Controller(journal_path=path, journal=SEG_CFG)
+        ids = [c.submit("echo", {"i": i}) for i in range(6)]
+        drain_n(c, 2)
+        live = snapshot_of(c, ids)
+        c.close()
+        with open(path + ".snapshot", "w", encoding="utf-8") as f:
+            f.write('{"version": 1, "through_seq": 99, "jobs": [')  # torn
+        c2 = Controller(journal_path=path, journal=SEG_CFG)
+        states_equal(live, snapshot_of(c2, ids))
+        snap = c2.metrics.snapshot()
+        (s,) = snap["controller_journal_snapshot_invalid_total"]["series"]
+        assert s["value"] == 1
+        assert c2.journal_replayed_events > 0
+        c2.close()
+
+    def test_terminal_retention_bounds_snapshot(self, tmp_path):
+        """SNAPSHOT_RETAIN_TERMINAL: old droppable terminal jobs leave
+        the snapshot (restart forgets them — late duplicates reject as
+        unknown job, still at-most-once), live jobs and depended-on
+        terminal jobs always survive."""
+        path = str(tmp_path / "j.jsonl")
+        cfg = JournalConfig(
+            segment_max_bytes=4096, snapshot_retain_terminal=2
+        )
+        c = Controller(journal_path=path, journal=cfg)
+        # A completed map-reduce whose shards stay depended-on...
+        shard_ids, reduce_id = c.submit_csv_job(
+            "d.csv", total_rows=100, shard_size=50,
+            reduce_op="risk_accumulate", collect_partials=True,
+        )
+        drain_n(c, 2, ops=("read_csv_shard",))
+        # ...the reduce stays PENDING (never leased): its deps must
+        # never drop. Plus 6 droppable terminal singles and 1 live one.
+        singles = [c.submit("echo", {"i": i}) for i in range(7)]
+        drain_n(c, 6)
+        c.maybe_snapshot(force=True)
+        c.close()
+
+        c2 = Controller(journal_path=path, journal=cfg)
+        # Depended-on shards survive (reduce is still pending).
+        for sid in shard_ids:
+            assert c2.job_snapshot(sid)["state"] == "succeeded"
+        assert c2.job_snapshot(reduce_id)["state"] == "pending"
+        # Only the 2 newest droppable singles survive; the live one too.
+        survivors = [s for s in singles if s in c2._jobs]
+        assert singles[-1] in survivors          # the pending single
+        assert len(survivors) == 3               # 2 retained + 1 live
+        assert survivors[-3:] == singles[-3:]    # newest-first retention
+        # A late duplicate for a forgotten job: cleanly rejected.
+        out = c2.report("lease-x", singles[0], 0, "succeeded", {})
+        assert out["accepted"] is False
+        assert out["reason"] == "unknown job"
+        # And the reduce still materializes its ordered partials.
+        lease = c2.lease("a", {"ops": ["risk_accumulate"]})
+        assert len(lease["tasks"][0]["payload"]["partials"]) == 2
+        c2.close()
+
+    def test_usage_ledger_survives_snapshot(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        c = Controller(journal_path=path, journal=SNAP_CFG)
+        jid = c.submit("echo", {}, tenant="acme", priority=7)
+        lease = c.lease("a", {"ops": ["echo"]})
+        c.report(lease["lease_id"], jid, 0, "succeeded",
+                 {"ok": True, "usage": {"device_s": 1.5, "rows": 10}})
+        c.maybe_snapshot(force=True)
+        billed = c.usage.billed_tasks
+        attempts = c.usage.job_billed_attempts()
+        c.close()
+        c2 = Controller(journal_path=path, journal=SNAP_CFG)
+        assert c2.usage.billed_tasks == billed == 1
+        assert c2.usage.job_billed_attempts() == attempts
+        report = c2.usage.report()
+        assert report["by_tenant"]["acme"]["device_seconds"] == 1.5
+        assert report["by_tenant"]["acme"]["rows"] == 10
+        # The (job, attempt) dedupe survives too: a replayed duplicate
+        # bill is rejected.
+        assert c2.usage.bill(jid, tenant="acme", tier=7, op="echo",
+                             attempt=1, usage={"device_s": 9.0}) is None
+        c2.close()
+
+
+class TestTornLinePositions:
+    """Parameterized torn-final-line matrix (ISSUE 14 satellite): the
+    existing torn_tail/replay_skipped counters still fire in every
+    position and state converges."""
+
+    def _build(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        c = Controller(journal_path=path, journal=SEG_CFG)
+        ids = [c.submit("echo", {"i": i, "pad": "x" * 60})
+               for i in range(12)]
+        drain_n(c, 5)
+        live = snapshot_of(c, ids)
+        c.close()
+        segs = list_segments(path)
+        assert len(segs) >= 3
+        return path, ids, live, segs
+
+    @pytest.mark.parametrize("position", ["mid_segment", "tail_segment"])
+    def test_torn_line_positions(self, tmp_path, position):
+        path, ids, live, segs = self._build(tmp_path)
+        torn = '{"ev": "result", "job_id'
+        if position == "mid_segment":
+            # Torn line at the end of a NON-final segment: mid-stream
+            # corruption → the skipped counter, not torn_tail.
+            with open(segs[0][1], "a", encoding="utf-8") as f:
+                f.write(torn)
+            want_torn, want_skipped = 0, 1
+        else:
+            # Torn final line of the FINAL segment: the expected crash
+            # artifact → tolerated, counted torn_tail.
+            with open(segs[-1][1], "a", encoding="utf-8") as f:
+                f.write(torn)
+            want_torn, want_skipped = 1, 0
+        c2 = Controller(journal_path=path, journal=SEG_CFG)
+        assert c2.journal_torn_tail == want_torn
+        assert c2.journal_replay_skipped == want_skipped
+        states_equal(live, snapshot_of(c2, ids))
+        c2.close()
+
+    def test_torn_snapshot_position(self, tmp_path):
+        """Torn SNAPSHOT + torn tail segment at once: snapshot ignored
+        (invalid counter), segments replay, torn_tail still fires."""
+        path, ids, live, segs = self._build(tmp_path)
+        with open(path + ".snapshot", "w", encoding="utf-8") as f:
+            f.write('{"version": 1,')
+        with open(segs[-1][1], "a", encoding="utf-8") as f:
+            f.write('{"ev": "result"')
+        c2 = Controller(journal_path=path, journal=SEG_CFG)
+        assert c2.journal_torn_tail == 1
+        assert c2.journal_replay_skipped == 0
+        snap = c2.metrics.snapshot()
+        (s,) = snap["controller_journal_snapshot_invalid_total"]["series"]
+        assert s["value"] == 1
+        states_equal(live, snapshot_of(c2, ids))
+        c2.close()
+
+
+class TestFsync:
+    def test_fsync_off_by_default_and_on_when_asked(self, tmp_path):
+        """Both durability paths (ISSUE 14 satellite): default writes are
+        flush-only; JOURNAL_FSYNC=1 fdatasyncs per append; fsync_every=N
+        group-commits."""
+        off = SegmentedJournal(str(tmp_path / "off.jsonl"))
+        off.open_for_append()
+        off.append({"ev": "submit", "job_id": "a", "op": "echo"})
+        assert off.fsyncs == 0
+        off.close()
+
+        per = SegmentedJournal(str(tmp_path / "per.jsonl"), fsync=True)
+        per.open_for_append()
+        for i in range(3):
+            per.append({"ev": "submit", "job_id": f"j{i}", "op": "echo"})
+        assert per.fsyncs == 3
+        per.close()
+
+        grp = SegmentedJournal(
+            str(tmp_path / "grp.jsonl"), fsync=True, fsync_every=4
+        )
+        grp.open_for_append()
+        for i in range(6):
+            grp.append({"ev": "submit", "job_id": f"j{i}", "op": "echo"})
+        assert grp.fsyncs == 1   # one group commit at 4
+        grp.close()
+        assert grp.fsyncs == 2   # close drains the unsynced remainder
+
+    def test_fsync_journal_replays_identically(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        cfg = JournalConfig(fsync=True, fsync_every=2)
+        c = Controller(journal_path=path, journal=cfg)
+        ids = [c.submit("echo", {"i": i}) for i in range(4)]
+        drain_n(c, 2)
+        live = snapshot_of(c, ids)
+        c.close()
+        c2 = Controller(journal_path=path, journal=cfg)
+        states_equal(live, snapshot_of(c2, ids))
+        c2.close()
+
+    def test_journal_config_from_env(self, monkeypatch):
+        monkeypatch.setenv("JOURNAL_FSYNC", "1")
+        monkeypatch.setenv("JOURNAL_FSYNC_EVERY", "16")
+        monkeypatch.setenv("JOURNAL_SEGMENT_MAX_BYTES", "1048576")
+        monkeypatch.setenv("SNAPSHOT_EVERY_EVENTS", "5000")
+        cfg = JournalConfig.from_env()
+        assert cfg.fsync is True
+        assert cfg.fsync_every == 16
+        assert cfg.segment_max_bytes == 1048576
+        assert cfg.snapshot_every_events == 5000
+
+
+class TestTailer:
+    def test_tail_across_rotation_and_partial_lines(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = SegmentedJournal(path, segment_max_events=3)
+        j.open_for_append()
+        tail = JournalTailer(path)
+        for i in range(4):
+            j.append({"ev": "submit", "job_id": f"j{i}", "op": "echo"})
+        got = tail.poll()
+        assert [e["job_id"] for e in got] == ["j0", "j1", "j2", "j3"]
+        # A partial (newline-less) line is held back until complete.
+        j._file.write('{"ev": "submit", "job_id": "j4"')
+        j._file.flush()
+        assert tail.poll() == []
+        assert tail.lag_bytes() > 0
+        j._file.write(', "op": "echo"}\n')
+        j._file.flush()
+        assert [e["job_id"] for e in tail.poll()] == ["j4"]
+        assert tail.lag_bytes() == 0
+        j.close()
+
+    def test_seal_truncates_only_torn_tail(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = SegmentedJournal(path, segment_max_events=100)
+        j.open_for_append()
+        j.append({"ev": "submit", "job_id": "whole", "op": "echo"})
+        tail = JournalTailer(path)
+        tail.poll()
+        # One late complete event + one torn write after the last poll.
+        j.append({"ev": "submit", "job_id": "late", "op": "echo"})
+        j._file.write('{"ev": "submit", "job_id": "torn"')
+        j._file.flush()
+        late, cut = tail.seal()
+        assert [e["job_id"] for e in late] == ["late"]
+        assert cut == len('{"ev": "submit", "job_id": "torn"')
+        # The file now ends at the last complete line.
+        seg = list_segments(path)[-1][1]
+        lines = open(seg, encoding="utf-8").read().splitlines()
+        assert json.loads(lines[-1])["job_id"] == "late"
+
+
+class TestHotStandby:
+    def _controller(self, path, **kw):
+        return Controller(
+            journal_path=path, journal=SNAP_CFG, lease_ttl_sec=30.0, **kw
+        )
+
+    def test_warm_replica_tracks_primary(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        prim = self._controller(path)
+        sb = HotStandby(path, journal=SNAP_CFG, poll_interval_sec=0.01)
+        sb.start()
+        try:
+            [prim.submit("echo", {"i": i}) for i in range(8)]
+            drain_n(prim, 5)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if sb.replica_counts() == {"succeeded": 5, "pending": 3} \
+                        and sb.lag_bytes() == 0:
+                    break
+                time.sleep(0.01)
+            assert sb.replica_counts() == {"succeeded": 5, "pending": 3}
+            assert sb.lag_bytes() == 0
+        finally:
+            sb.stop()
+            prim.close()
+
+    def test_compaction_outrunning_tail_resyncs(self, tmp_path):
+        """A snapshot that GCs segments the standby has not finished
+        reading must not lose events: the tailer flags a resync and the
+        replica reloads from the snapshot (which folds them in)."""
+        path = str(tmp_path / "j.jsonl")
+        cfg = JournalConfig(segment_max_events=5)
+        prim = Controller(journal_path=path, journal=cfg)
+        sb = HotStandby(path, journal=cfg)  # never started: manual polls
+        ids = [prim.submit("echo", {"i": i}) for i in range(12)]
+        assert sb.catch_up() == 12
+        # More traffic + a compacting snapshot: the segments under the
+        # standby's cursor are garbage-collected.
+        ids += [prim.submit("echo", {"i": i}) for i in range(12, 22)]
+        drain_n(prim, 4)
+        prim.maybe_snapshot(force=True)
+        sb.catch_up()  # events arrive via the snapshot, not the tail
+        assert sb.resyncs >= 1
+        assert sb.replica_counts() == prim.counts()
+        assert all(j in sb.controller._jobs for j in ids)
+        prim.close()
+
+    def test_promote_apply_once_or_cleanly_rejected(self, tmp_path):
+        """The ISSUE 14 fencing bar: results posted to the OLD incarnation
+        are applied-once (spool redelivery accepted at the same epoch) or
+        cleanly rejected (journaled fences + terminal guard replay)."""
+        path = str(tmp_path / "j.jsonl")
+        prim = self._controller(path)
+        sb = HotStandby(path, journal=SNAP_CFG, poll_interval_sec=0.01)
+        sb.start()
+        try:
+            done_id = prim.submit("echo", {}, job_id="done")
+            inflight_id = prim.submit("echo", {}, job_id="inflight")
+            fenced_id = prim.submit("echo", {}, job_id="fenced")
+            drain_n(prim, 1)                      # "done" completes
+            inflight = prim.lease("a", {"ops": ["echo"]})  # "inflight"
+            # "fenced": lease expires on the primary → journaled epoch bump.
+            clockless = prim.lease("b", {"ops": ["echo"]})
+            prim._jobs[fenced_id].lease_deadline = -1.0  # force expiry
+            prim.sweep()
+            time.sleep(0.2)  # let the tail drain
+        finally:
+            sb.stop()
+        # Primary "dies" (no close — handles just stop being used).
+        promoted = sb.promote()
+        try:
+            assert promoted.counts() == {"succeeded": 1, "pending": 2}
+            # 1. duplicate of the completed job: cleanly rejected.
+            out = promoted.report("lease-x", done_id, 0, "succeeded", {})
+            assert out["accepted"] is False
+            assert out["reason"] == "already complete"
+            # 2. the old incarnation's fence replays: stale epoch rejected.
+            out = promoted.report(
+                clockless["lease_id"], fenced_id, 0, "succeeded", {})
+            assert out["accepted"] is False
+            assert out["reason"] == "stale epoch"
+            # 3. the in-flight agent's spooled result redelivers at its
+            # original epoch: applied exactly once.
+            t = inflight["tasks"][0]
+            out = promoted.report(
+                inflight["lease_id"], inflight_id, t["job_epoch"],
+                "succeeded", {"ok": True})
+            assert out["accepted"] is True
+            out = promoted.report(
+                inflight["lease_id"], inflight_id, t["job_epoch"],
+                "succeeded", {"ok": True})
+            assert out["accepted"] is False  # second application rejected
+            assert promoted.promotions == 1
+            assert promoted.journal_status()["promotions"] == 1
+        finally:
+            promoted.close()
+
+    def test_promotion_survives_replay(self, tmp_path):
+        """The promoted incarnation's appends land on a fresh segment and
+        the whole healed chain replays clean."""
+        path = str(tmp_path / "j.jsonl")
+        prim = self._controller(path)
+        ids = [prim.submit("echo", {"i": i}) for i in range(4)]
+        drain_n(prim, 2)
+        # Torn death write, no close.
+        prim._journal_impl._file.write('{"ev": "result", "job_')
+        prim._journal_impl._file.flush()
+        prim._sweep_stop.set()
+
+        sb = HotStandby(path, journal=SNAP_CFG)
+        promoted = sb.promote()
+        assert sb.torn_sealed_bytes > 0
+        assert promoted.journal_torn_tail == 1  # operator-visible
+        drain_n(promoted, 2)
+        assert promoted.drained()
+        live = snapshot_of(promoted, ids)
+        promoted.close()
+
+        c2 = Controller(journal_path=path, journal=SNAP_CFG)
+        assert c2.journal_torn_tail == 0      # sealed at promotion
+        assert c2.journal_replay_skipped == 0
+        states_equal(live, snapshot_of(c2, ids))
+        c2.close()
+
+
+class FlakySession:
+    """Session whose post raises for URLs in `down`, else loops back."""
+
+    def __init__(self, controller, down):
+        self.inner = LoopbackSession(controller)
+        self.down = down
+        self.posts = []
+
+    def post(self, url, json=None, timeout=None):  # noqa: A002
+        self.posts.append(url)
+        for prefix in self.down:
+            if url.startswith(prefix):
+                raise ChaosTransportError(f"down: {url}")
+        return self.inner.post(url, json=json, timeout=timeout)
+
+
+class TestAgentFailover:
+    def _agent(self, controller, urls, down):
+        cfg = Config(agent=AgentConfig(
+            controller_url=urls[0], controller_urls=tuple(urls),
+            agent_name="fo", tasks=("echo",), idle_sleep_sec=0.01,
+            error_backoff_sec=0.01, retry_base_sec=0.005,
+            retry_max_sec=0.02, pipeline_depth=0,
+        ))
+        session = FlakySession(controller, down)
+        agent = Agent(config=cfg, session=session)
+        agent._profile = {"tier": "test"}
+        return agent, session
+
+    def test_urls_env_parse(self, monkeypatch):
+        monkeypatch.delenv("CONTROLLER_URL", raising=False)
+        monkeypatch.setenv(
+            "CONTROLLER_URLS", "http://p:8080, http://s:8080/"
+        )
+        cfg = AgentConfig.from_env()
+        assert cfg.controller_urls == ("http://p:8080", "http://s:8080")
+        # The list head doubles as the primary when CONTROLLER_URL unset.
+        assert cfg.controller_url == "http://p:8080"
+
+    def test_transport_error_rotates_sticky(self, tmp_path):
+        c = Controller()
+        jid = c.submit("echo", {"v": 1})
+        agent, session = self._agent(
+            c, ["http://primary", "http://standby"], down=["http://primary"]
+        )
+        assert agent.active_controller_url() == "http://primary"
+        # First lease hits the dead primary, rotates; the step's backoff
+        # returns False, the NEXT step leases from the standby.
+        agent.step()
+        assert agent.active_controller_url() == "http://standby"
+        assert agent.step() is True
+        assert c.job_snapshot(jid)["state"] == "succeeded"
+        snap = agent.obs.snapshot()
+        (fo,) = snap["controller_failovers_total"]["series"]
+        assert fo["value"] == 1
+        # Sticky: success pins the standby; no further rotation.
+        agent.step()
+        assert agent.active_controller_url() == "http://standby"
+
+    def test_spool_redelivers_to_standby(self):
+        """A completed result that failed to post to the dead primary
+        redelivers to the standby — the ISSUE 14 'redeliver instead of
+        drop' bar, spool + failover composing."""
+        c = Controller()
+        jid = c.submit("echo", {"v": 2})
+        agent, session = self._agent(
+            c, ["http://primary", "http://standby"], down=[]
+        )
+        lease = c.lease("fo", {"ops": ["echo"]})
+        # Primary dies between lease and post.
+        session.down = ["http://primary"]
+        t = lease["tasks"][0]
+        ok = agent.post_result(
+            lease["lease_id"], jid, t["job_epoch"], "succeeded",
+            {"ok": True}, op="echo",
+        )
+        assert ok is False and len(agent.spool) == 1
+        # Rotation happened inside the failed post; the flush delivers.
+        assert agent.active_controller_url() == "http://standby"
+        assert agent.flush_spool(force=True) == 1
+        assert c.job_snapshot(jid)["state"] == "succeeded"
+        assert len(agent.spool) == 0
+
+    def test_single_url_never_rotates(self):
+        c = Controller()
+        agent, session = self._agent(
+            c, ["http://primary"], down=["http://primary"]
+        )
+        agent.step()
+        assert agent.active_controller_url() == "http://primary"
+        snap = agent.obs.snapshot()
+        assert not snap["controller_failovers_total"]["series"]
+
+
+class TestUsageLedgerState:
+    def test_export_import_round_trip(self):
+        a = UsageLedger()
+        a.bill("j1", tenant="t1", tier=3, op="x", attempt=1,
+               usage={"device_s": 2.0, "rows": 7}, wire_bytes=10)
+        a.bill("j2", tenant="t2", tier=8, op="y", attempt=1,
+               usage={"device_s": 0.5, "flops": 1e9})
+        a.bill("j2", tenant="t2", tier=8, op="y", attempt=2,
+               usage={"device_s": 0.25})
+        doc = a.export_state()
+        # The export is JSON-serializable (it rides the snapshot).
+        doc = json.loads(json.dumps(doc))
+        b = UsageLedger()
+        b.import_state(doc)
+        assert b.billed_tasks == a.billed_tasks == 3
+        assert b.job_billed_attempts() == a.job_billed_attempts()
+        ra, rb = a.report(), b.report()
+        assert rb["by_tenant"] == ra["by_tenant"]
+        assert rb["totals"] == ra["totals"]
+        # Dedupe state survives: re-billing an imported attempt no-ops.
+        assert b.bill("j2", tenant="t2", tier=8, op="y", attempt=2,
+                      usage={"device_s": 9.9}) is None
+
+
+class TestSnapshotConcurrency:
+    def test_snapshot_under_live_traffic(self, tmp_path):
+        """Snapshots race live submits/reports without losing events: the
+        rotation + state capture are lock-ordered with appends."""
+        path = str(tmp_path / "j.jsonl")
+        cfg = JournalConfig(segment_max_bytes=2000, snapshot_every_events=25)
+        c = Controller(journal_path=path, journal=cfg)
+        stop = threading.Event()
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                jid = c.submit("echo", {"i": i})
+                lease = c.lease("a", {"ops": ["echo"]})
+                if lease:
+                    t = lease["tasks"][0]
+                    c.report(lease["lease_id"], t["id"], t["job_epoch"],
+                             "succeeded", {"ok": True})
+                i += 1
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if c.journal_status()["snapshots_written"] >= 3:
+                break
+            c.maybe_snapshot()
+            time.sleep(0.005)
+        stop.set()
+        t.join(timeout=5)
+        assert c.journal_status()["snapshots_written"] >= 3
+        live = {j: c.job_snapshot(j) for j in list(c._jobs)}
+        n = len(live)
+        c.close()
+        c2 = Controller(journal_path=path, journal=cfg)
+        assert len(c2._jobs) == n
+        states_equal(live, {j: c2.job_snapshot(j) for j in list(c2._jobs)})
+        assert c2.journal_torn_tail == 0
+        assert c2.journal_replay_skipped == 0
+        c2.close()
