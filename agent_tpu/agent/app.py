@@ -170,6 +170,13 @@ class Agent:
         self.m_device_idle = self.obs.counter(
             "device_idle_seconds_total",
             "Device-thread seconds blocked waiting for staged work")
+        # Serving (ISSUE 15): live occupancy of the continuous-batching
+        # decode engine's running batch — the "is iteration-level batching
+        # actually batching" signal swarmtop's serving row shows.
+        self.m_serve_occupancy = self.obs.gauge(
+            "serve_batch_occupancy",
+            "Continuous-batching running batch: requests currently seated "
+            "(0 when no serving work is in flight)")
         # Per-op device attribution (ISSUE 8): busy seconds carry the op so
         # /v1/health can say WHICH workload owns the device, not just that
         # it is busy. Fleet-merge/scrape consumers that sum the family are

@@ -80,6 +80,11 @@ class _Item:
     trace_id: Any = None
     span_parent: Any = None
     t_staged: float = 0.0         # when staging finished (queue-span start)
+    # Continuous serving (ISSUE 15): the engine handle while this item's
+    # requests ride the running batch, and the admit instant the execute
+    # span measures from.
+    serve_handle: Any = None
+    t_serve0: float = 0.0
 
 
 _STOP = object()
@@ -280,104 +285,226 @@ class PipelineRunner:
         except Exception:  # noqa: BLE001 — the op re-puts on execute anyway
             pass
 
+    def _serve_admit(self, item: Any, serving: list) -> None:
+        """Join a serving item's requests to the continuous decode engine:
+        prefill runs now (a batched compiled step on this, the device
+        thread), the decode iterations run in :meth:`_serve_pump_once`
+        interleaved with everything else the loop does."""
+        agent = self.agent
+        t0 = time.perf_counter()
+        item.t_serve0 = t0
+        try:
+            item.serve_handle = item.fn.serve_admit(item.staged, item.ctx)
+        except Exception as exc:  # noqa: BLE001 — op error → failed
+            item.status = "failed"
+            item.error = structured_error(exc)
+            agent.rate.log("exec", "serve admit raised", op=item.op,
+                           type=type(exc).__name__)
+            agent.recorder.record(
+                "error", phase="execute", job_id=item.job_id, op=item.op,
+                lease_id=item.lease_id, type=type(exc).__name__,
+                message=str(exc)[:200],
+            )
+            self._put_post(item)
+            return
+        # Prefill is device time; the decode iterations bill per pump.
+        agent.note_device_time(
+            item.op, time.perf_counter() - t0,
+            item.ctx.tags if item.ctx is not None else None,
+        )
+        agent.recorder.record(
+            "phase", phase="serve_admitted", job_id=item.job_id, op=item.op,
+            lease_id=item.lease_id,
+        )
+        serving.append(item)
+
+    def _serve_pump_once(self, serving: list) -> None:
+        """One decode iteration for every distinct engine with items in
+        flight (several leased jobs share one engine — pumping it once
+        advances all their slots), then post the items whose requests all
+        finished. Finished sequences freed their slots inside the engine
+        step, so backlogged requests joined BETWEEN iterations."""
+        agent = self.agent
+        engines: Dict[int, Any] = {}
+        for item in serving:
+            engines.setdefault(id(item.serve_handle["engine"]), item)
+        t0 = time.perf_counter()
+        occupancy = 0
+        for item in engines.values():
+            occupancy = max(occupancy, item.fn.serve_pump(item.serve_handle))
+        if engines:
+            first = next(iter(engines.values()))
+            # Decode-iteration device time, attributed once per pump (the
+            # overlapped items share the very same dispatch).
+            agent.note_device_time(first.op, time.perf_counter() - t0, None)
+            agent.m_serve_occupancy.set(occupancy)
+        for item in [
+            it for it in serving if it.fn.serve_done(it.serve_handle)
+        ]:
+            serving.remove(item)
+            try:
+                item.executed = item.fn.serve_collect(item.serve_handle)
+            except Exception as exc:  # noqa: BLE001
+                item.status = "failed"
+                item.error = structured_error(exc)
+                agent.recorder.record(
+                    "error", phase="execute", job_id=item.job_id,
+                    op=item.op, lease_id=item.lease_id,
+                    type=type(exc).__name__, message=str(exc)[:200],
+                )
+            item.serve_handle = None
+            dt = time.perf_counter() - item.t_serve0
+            agent.m_phase.observe(
+                dt, exemplar={"trace_id": item.job_id},
+                op=item.op, phase="execute",
+            )
+            agent.trace_span(
+                "execute", item.trace_id, item.span_parent,
+                start_mono=item.t_serve0, duration_s=dt,
+                op=item.op, status=item.status,
+            )
+            agent.recorder.record(
+                "phase", phase="executed", job_id=item.job_id, op=item.op,
+                lease_id=item.lease_id, status=item.status,
+            )
+            self._put_post(item)
+        if not serving:
+            agent.m_serve_occupancy.set(0)
+
     def _execute_loop(self) -> None:
         agent = self.agent
         pending: Any = None
+        # Continuous-serving items currently riding a decode engine
+        # (ISSUE 15): the loop interleaves one engine iteration per pass
+        # with ordinary staged work, so interactive decode keeps stepping
+        # while bulk shards stage and new serving jobs join between steps.
+        serving: list = []
+        stopping = False
         try:
             while True:
+                item = None
                 if pending is not None:
                     item, pending = pending, None
-                else:
-                    # Busy/idle attribution (the tf.data question — is the
-                    # input stage or the accelerator the limiter?): time
-                    # blocked here is device idle; time inside the op
-                    # dispatch is device busy.
-                    t_wait = time.perf_counter()
-                    item = self.staged_q.get()
-                    agent.m_device_idle.inc(time.perf_counter() - t_wait)
+                elif not stopping:
+                    if serving:
+                        # Decode in flight: never block on the queue — an
+                        # empty poll just means this pass is pure decode.
+                        try:
+                            item = self.staged_q.get_nowait()
+                        except queue.Empty:
+                            item = None
+                    else:
+                        # Busy/idle attribution (the tf.data question — is
+                        # the input stage or the accelerator the limiter?):
+                        # time blocked here is device idle; time inside the
+                        # op dispatch is device busy.
+                        t_wait = time.perf_counter()
+                        item = self.staged_q.get()
+                        agent.m_device_idle.inc(time.perf_counter() - t_wait)
                 if item is _STOP:
+                    # Keep pumping until in-flight serving work posts —
+                    # a leased request must answer even through shutdown.
+                    stopping = True
+                    item = None
+                if item is not None:
+                    self._execute_item(item, serving)
+                    pending = self._peeked
+                    self._peeked = None
+                if serving:
+                    self._serve_pump_once(serving)
+                if stopping and not serving and pending is None:
                     break
-                agent.m_queue.set(self.staged_q.qsize(), queue="staged")
-                if item.result is not None or item.status == "failed":
-                    self._put_post(item)
-                    continue
-                if self.double_buffer:
-                    # Peek-ahead: grab the next staged item (if any) and
-                    # issue its transfers now, so they run under the current
-                    # item's execute. The popped item is held locally and
-                    # consumed on the next loop iteration — never lost.
-                    try:
-                        pending = self.staged_q.get_nowait()
-                    except queue.Empty:
-                        pending = None
-                    if pending is not None and pending is not _STOP:
-                        self._prefeed(pending)
-                t_exec = time.perf_counter()
-                if item.t_staged:
-                    # Time spent waiting in the staged queue — the
-                    # backpressure gap between host staging and the device.
-                    agent.trace_span(
-                        "queue", item.trace_id, item.span_parent,
-                        start_mono=item.t_staged,
-                        duration_s=t_exec - item.t_staged, op=item.op,
-                    )
-                # Pre-minted so compile spans emitted inside the dispatch
-                # (executor cache misses) parent to this execute span.
-                exec_span_id = new_span_id()
-                trace_ctx = TraceContext(
-                    trace_id=item.trace_id or item.job_id,
-                    parent_span_id=exec_span_id,
-                    tracer=agent.tracer,
-                    registry=agent.obs,
-                    process=agent._process_name(),
-                )
-                try:
-                    # profiled_call covers phased ops too — PROFILE_DIR
-                    # traces capture the device phase either way (§5.1).
-                    with use_context(trace_ctx):
-                        if item.monolithic:
-                            item.result = agent.profiled_call(
-                                item.op,
-                                lambda i=item: i.fn(i.payload, i.ctx),
-                            )
-                        else:
-                            item.executed = agent.profiled_call(
-                                item.op,
-                                lambda i=item: i.fn.execute(i.staged, i.ctx),
-                            )
-                except Exception as exc:  # noqa: BLE001 — op error → failed
-                    item.status = "failed"
-                    item.error = structured_error(exc)
-                    agent.rate.log("exec", "op raised", op=item.op,
-                                   type=type(exc).__name__)
-                    agent.recorder.record(
-                        "error", phase="execute", job_id=item.job_id,
-                        op=item.op, lease_id=item.lease_id,
-                        type=type(exc).__name__, message=str(exc)[:200],
-                    )
-                dt = time.perf_counter() - t_exec
-                # Per-op device attribution + duty/MFU rollup (ISSUE 8).
-                agent.note_device_time(
-                    item.op, dt,
-                    item.ctx.tags if item.ctx is not None else None,
-                )
-                agent.m_phase.observe(
-                    dt, exemplar={"trace_id": item.job_id},
-                    op=item.op, phase="execute",
-                )
-                agent.trace_span(
-                    "execute", item.trace_id, item.span_parent,
-                    span_id=exec_span_id, start_mono=t_exec, duration_s=dt,
-                    op=item.op, status=item.status,
-                )
-                agent.recorder.record(
-                    "phase", phase="executed", job_id=item.job_id,
-                    op=item.op, lease_id=item.lease_id,
-                    status=item.status,
-                )
-                self._put_post(item)
         finally:
             self._put_post(_STOP)  # same lost-sentinel guard as the stager
+
+    _peeked: Any = None
+
+    def _execute_item(self, item: Any, serving: list) -> None:
+        agent = self.agent
+        agent.m_queue.set(self.staged_q.qsize(), queue="staged")
+        if item.result is not None or item.status == "failed":
+            self._put_post(item)
+            return
+        if getattr(item.fn, "serve_admit", None) is not None \
+                and not item.monolithic:
+            self._serve_admit(item, serving)
+            return
+        if self.double_buffer:
+            # Peek-ahead: grab the next staged item (if any) and issue its
+            # transfers now, so they run under the current item's execute.
+            # The popped item is handed back to the loop via _peeked and
+            # consumed on the next iteration — never lost.
+            try:
+                peeked = self.staged_q.get_nowait()
+            except queue.Empty:
+                peeked = None
+            if peeked is not None and peeked is not _STOP:
+                self._prefeed(peeked)
+            self._peeked = peeked
+        t_exec = time.perf_counter()
+        if item.t_staged:
+            # Time spent waiting in the staged queue — the
+            # backpressure gap between host staging and the device.
+            agent.trace_span(
+                "queue", item.trace_id, item.span_parent,
+                start_mono=item.t_staged,
+                duration_s=t_exec - item.t_staged, op=item.op,
+            )
+        # Pre-minted so compile spans emitted inside the dispatch
+        # (executor cache misses) parent to this execute span.
+        exec_span_id = new_span_id()
+        trace_ctx = TraceContext(
+            trace_id=item.trace_id or item.job_id,
+            parent_span_id=exec_span_id,
+            tracer=agent.tracer,
+            registry=agent.obs,
+            process=agent._process_name(),
+        )
+        try:
+            # profiled_call covers phased ops too — PROFILE_DIR
+            # traces capture the device phase either way (§5.1).
+            with use_context(trace_ctx):
+                if item.monolithic:
+                    item.result = agent.profiled_call(
+                        item.op,
+                        lambda i=item: i.fn(i.payload, i.ctx),
+                    )
+                else:
+                    item.executed = agent.profiled_call(
+                        item.op,
+                        lambda i=item: i.fn.execute(i.staged, i.ctx),
+                    )
+        except Exception as exc:  # noqa: BLE001 — op error → failed
+            item.status = "failed"
+            item.error = structured_error(exc)
+            agent.rate.log("exec", "op raised", op=item.op,
+                           type=type(exc).__name__)
+            agent.recorder.record(
+                "error", phase="execute", job_id=item.job_id,
+                op=item.op, lease_id=item.lease_id,
+                type=type(exc).__name__, message=str(exc)[:200],
+            )
+        dt = time.perf_counter() - t_exec
+        # Per-op device attribution + duty/MFU rollup (ISSUE 8).
+        agent.note_device_time(
+            item.op, dt,
+            item.ctx.tags if item.ctx is not None else None,
+        )
+        agent.m_phase.observe(
+            dt, exemplar={"trace_id": item.job_id},
+            op=item.op, phase="execute",
+        )
+        agent.trace_span(
+            "execute", item.trace_id, item.span_parent,
+            span_id=exec_span_id, start_mono=t_exec, duration_s=dt,
+            op=item.op, status=item.status,
+        )
+        agent.recorder.record(
+            "phase", phase="executed", job_id=item.job_id,
+            op=item.op, lease_id=item.lease_id,
+            status=item.status,
+        )
+        self._put_post(item)
 
     # ---- poster thread ----
 

@@ -607,6 +607,67 @@ class AutoscaleConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Online-serving front door knobs (ISSUE 15 — the SERVE_* env surface).
+
+    ``POST /v1/infer`` requests coalesce into length-bucketed batches under
+    a ``max_wait_ms`` deadline / ``max_batch`` cap at the controller, then
+    ride the ordinary job queue as interactive-tier jobs; agent-side, the
+    continuous-batching decode engine runs ``decode_slots`` requests ×
+    ``num_beams`` beam rows as its fixed-capacity running batch."""
+
+    enabled: bool = True                   # SERVE_ENABLED
+    # Batch coalescing: a bucket flushes the moment it holds max_batch
+    # requests, or when its oldest request has waited max_wait_ms.
+    max_wait_ms: float = 25.0              # SERVE_MAX_WAIT_MS
+    max_batch: int = 16                    # SERVE_MAX_BATCH
+    # Admission: queued-or-batched infer requests past this bound get the
+    # existing 429 + retry_after_ms backpressure answer (0 = unbounded).
+    max_pending: int = 1024                # SERVE_MAX_PENDING
+    # Interactive-tier priority the flushed batch jobs carry (the fair
+    # scheduler's tier lane; the default SLO objectives judge tier 8).
+    priority: int = 8                      # SERVE_PRIORITY
+    # Length buckets (input bytes) — padding waste per batch is bounded by
+    # the gap to the next bucket edge.
+    len_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024)
+    # Agent-side: running-batch capacity (requests) of the continuous
+    # decode engine.
+    decode_slots: int = 8                  # SERVE_DECODE_SLOTS
+    # Decode iterations fused per engine dispatch: 1 = pure iteration-level
+    # batching (membership may change between every step); >1 amortizes
+    # per-step dispatch overhead where it dominates (tiny models, CPU,
+    # tunneled chips) — joins/exits then happen between chunks.
+    decode_micro_steps: int = 1            # SERVE_MICRO_STEPS
+    # HTTP long-poll cap for blocking POST /v1/infer / ?wait_ms GETs.
+    wait_timeout_sec: float = 60.0         # SERVE_WAIT_TIMEOUT_SEC
+
+    @staticmethod
+    def from_env() -> "ServeConfig":
+        buckets = []
+        for tok in env_str("SERVE_LEN_BUCKETS", "").split(","):
+            tok = tok.strip()
+            if tok:
+                try:
+                    buckets.append(int(tok))
+                except ValueError:
+                    pass
+        buckets = tuple(sorted(b for b in buckets if b > 0))
+        return ServeConfig(
+            enabled=env_bool("SERVE_ENABLED", True),
+            max_wait_ms=max(0.0, env_float("SERVE_MAX_WAIT_MS", 25.0)),
+            max_batch=max(1, env_int("SERVE_MAX_BATCH", 16)),
+            max_pending=max(0, env_int("SERVE_MAX_PENDING", 1024)),
+            priority=min(9, max(0, env_int("SERVE_PRIORITY", 8))),
+            len_buckets=buckets or ServeConfig.len_buckets,
+            decode_slots=max(1, env_int("SERVE_DECODE_SLOTS", 8)),
+            decode_micro_steps=max(1, env_int("SERVE_MICRO_STEPS", 1)),
+            wait_timeout_sec=max(
+                0.1, env_float("SERVE_WAIT_TIMEOUT_SEC", 60.0)
+            ),
+        )
+
+
+@dataclass(frozen=True)
 class OpsConfig:
     """Per-op knobs (reference ``ops/map_summarize.py:9-10``, trigger envs)."""
 
@@ -646,6 +707,7 @@ class Config:
     sizing: SizingConfig = field(default_factory=SizingConfig)
     ops: OpsConfig = field(default_factory=OpsConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     @staticmethod
     def from_env() -> "Config":
@@ -655,4 +717,5 @@ class Config:
             sizing=SizingConfig.from_env(),
             ops=OpsConfig.from_env(),
             sched=SchedConfig.from_env(),
+            serve=ServeConfig.from_env(),
         )

@@ -69,9 +69,16 @@ from agent_tpu.config import (
     ObsConfig,
     TRUTHY_TOKENS,
     SchedConfig,
+    ServeConfig,
     SloConfig,
 )
 from agent_tpu.controller.journal import SegmentedJournal
+from agent_tpu.controller.serving import (
+    DONE as SERVE_DONE,
+    SERVE_OPS,
+    ServeBatch,
+    ServeFrontDoor,
+)
 from agent_tpu.data import wire
 from agent_tpu.obs.health import build_health
 from agent_tpu.obs.profile import CaptureCoordinator, HostProfiler
@@ -222,6 +229,7 @@ class Controller:
         slo: Optional[SloConfig] = None,
         obs: Optional[ObsConfig] = None,
         journal: Optional[JournalConfig] = None,
+        serve: Optional[ServeConfig] = None,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
         # Binary shard wire (ISSUE 6): False = never negotiate (a JSON-only
@@ -397,6 +405,42 @@ class Controller:
                 window_sec=self.obs_config.tsdb_window_sec,
                 interval_sec=self.obs_config.tsdb_interval_sec,
                 clock=self._clock,
+            )
+        # Online-serving front door (ISSUE 15): POST /v1/infer requests
+        # coalesce into length-bucketed interactive-tier batch jobs.
+        # SERVE_ENABLED=0 leaves the door None and 501s the route.
+        self.serve_config = serve if serve is not None else ServeConfig()
+        self.serve_door: Optional[ServeFrontDoor] = None
+        self._m_serve_requests = m.counter(
+            "serve_requests_total",
+            "POST /v1/infer requests by op and outcome "
+            "(accepted/completed/failed/rejected)", ("op", "outcome"))
+        self._m_serve_batches = m.counter(
+            "serve_batches_total",
+            "Coalesced serving batches by flush reason (full = hit "
+            "SERVE_MAX_BATCH, deadline = oldest waited SERVE_MAX_WAIT_MS)",
+            ("op", "reason"))
+        self._m_serve_ttft = m.histogram(
+            "serve_ttft_seconds",
+            "Serving time-to-first-token per op (arrival -> first decode "
+            "token; classify: arrival -> answer)", ("op",))
+        self._m_serve_latency = m.histogram(
+            "serve_latency_seconds",
+            "Serving request latency per op (arrival -> completion fan-out)",
+            ("op",))
+        self._m_serve_tokens = m.counter(
+            "serve_tokens_total",
+            "Tokens emitted for completed serving requests", ("op",))
+        # controller_-prefixed: the agents' live `serve_batch_occupancy`
+        # gauge is the canonical one (the engine lives there) and the two
+        # must not collide in the merged /v1/metrics exposition.
+        self._m_serve_occupancy = m.gauge(
+            "controller_serve_batch_occupancy",
+            "Continuous-batching running-batch occupancy (mean requests "
+            "seated per decode step, as reported by the last serving batch)")
+        if self.serve_config.enabled:
+            self.serve_door = ServeFrontDoor(
+                self.serve_config, clock=self._clock
             )
         self.captures = CaptureCoordinator()
         # Built on first GET /v1/profile/host (a controller never asked for
@@ -975,6 +1019,11 @@ class Controller:
             # is the no-traffic evaluation cadence. Outside the lock: the
             # alert hook does file I/O on page entry.
             self.slo.evaluate()
+        # Serving flush/reap (ISSUE 15): the sweeper keeps the front door
+        # moving with no lease traffic — deadline flushes and completion
+        # fan-outs don't wait for an agent poll.
+        if self.serve_door is not None:
+            self._serve_pump()
         # Trend ring (ISSUE 9): the sweeper is the steady sampling cadence;
         # the lease path backstops it under sweeper-less tests/drains.
         self._tsdb_sample()
@@ -1475,6 +1524,12 @@ class Controller:
         draining: bool = False,
         **_ignored: Any,
     ) -> Optional[Dict[str, Any]]:
+        # Serving flush (ISSUE 15): deadline-expired infer buckets become
+        # leasable jobs BEFORE this poll's take, so the asking agent can
+        # carry them now instead of a poll cycle later. Outside the state
+        # lock by construction (the front door has its own).
+        if self.serve_door is not None:
+            self._serve_pump()
         try:
             return self._lease_impl(
                 agent, capabilities=capabilities, max_tasks=max_tasks,
@@ -1784,6 +1839,33 @@ class Controller:
         wire_bytes: int = 0,
         **_ignored: Any,
     ) -> Dict[str, Any]:
+        out = self._report_impl(
+            lease_id, job_id, job_epoch, status, result=result, error=error,
+            spans=spans, wire_bytes=wire_bytes, **_ignored,
+        )
+        # Serving fan-out (ISSUE 15): an accepted application may have
+        # landed a serve batch job terminal — complete its riders now, not
+        # on the next sweep. Outside the state lock (the reap re-takes it
+        # briefly per job; lock order controller → front door, never both).
+        if (
+            self.serve_door is not None
+            and out.get("accepted") and not out.get("released")
+        ):
+            self._serve_reap()
+        return out
+
+    def _report_impl(
+        self,
+        lease_id: str,
+        job_id: str,
+        job_epoch: Any,
+        status: str,
+        result: Any = None,
+        error: Any = None,
+        spans: Any = None,
+        wire_bytes: int = 0,
+        **_ignored: Any,
+    ) -> Dict[str, Any]:
         """One result post. Stale epochs are counted and discarded.
 
         ``spans`` is the agent's piggybacked span batch (ISSUE 5) — ingested
@@ -2007,6 +2089,201 @@ class Controller:
                 record["usage"] = billed_usage
             self._journal(record)
             return {"accepted": True}
+
+    # ---- online serving front door (ISSUE 15) ----
+
+    def _require_serve(self) -> ServeFrontDoor:
+        if self.serve_door is None:
+            raise RuntimeError("serving is disabled (SERVE_ENABLED=0)")
+        return self.serve_door
+
+    def submit_infer(
+        self,
+        op: str,
+        text: Any,
+        params: Optional[Dict[str, Any]] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+    ) -> str:
+        """One ``POST /v1/infer`` request → req_id. The request joins a
+        length-bucketed coalescing bucket; a bucket that fills flushes to
+        the job queue immediately, a partial one flushes on the lease/sweep
+        cadence once its oldest rider waited ``SERVE_MAX_WAIT_MS``. Raises
+        ``ValueError`` (HTTP 400) / ``AdmissionError`` (HTTP 429)."""
+        door = self._require_serve()
+        try:
+            req, full = door.submit(
+                op, text, params=params, tenant=tenant, priority=priority,
+            )
+        except AdmissionError:
+            self._m_serve_requests.inc(op=str(op), outcome="rejected")
+            self.recorder.record(
+                "serve_rejected", op=str(op), tenant=tenant,
+            )
+            raise
+        self._m_serve_requests.inc(op=req.op, outcome="accepted")
+        self.recorder.record(
+            "serve_request", req_id=req.req_id, op=req.op, tenant=req.tenant,
+        )
+        self._submit_serve_batches(full)
+        return req.req_id
+
+    def _submit_serve_batches(self, batches: List[ServeBatch]) -> None:
+        """Flushed buckets → ordinary jobs on the existing queue (priority
+        tier, tenant, journal, fencing all inherited). A job-queue admission
+        refusal fails the riders visibly rather than re-queueing them —
+        backpressure at the front door is the 429 the submitter already got;
+        a full JOB queue behind it means the system is saturated."""
+        door = self.serve_door
+        if door is None:
+            return
+        for batch in batches:
+            job_id = f"serve-{uuid.uuid4().hex[:12]}"
+            try:
+                self.submit(
+                    SERVE_OPS[batch.key.op],
+                    batch.job_payload(),
+                    job_id=job_id,
+                    priority=batch.key.priority,
+                    tenant=batch.key.tenant,
+                )
+            except AdmissionError as exc:
+                completed = door.fail_batch(batch, {
+                    "type": "AdmissionError",
+                    "message": str(exc),
+                })
+                self._note_serve_completions(completed)
+            else:
+                door.mark_batched(batch, job_id)
+                self._m_serve_batches.inc(
+                    op=batch.key.op, reason=batch.reason
+                )
+                self.recorder.record(
+                    "serve_batch", job_id=job_id, op=batch.key.op,
+                    n_requests=len(batch.requests), reason=batch.reason,
+                )
+
+    def _serve_pump(self) -> None:
+        """Deadline-flush due buckets and reap terminal serve jobs — driven
+        by the lease path, the sweeper, and the HTTP wait loops, so the
+        front door makes progress under any one of them."""
+        door = self.serve_door
+        if door is None:
+            return
+        self._submit_serve_batches(door.pop_due())
+        self._serve_reap()
+
+    def _serve_reap(self) -> None:
+        """Fan terminal serve jobs' results out to their riding requests.
+        The catch-all completion path: covers result application, retry
+        exhaustion, deadline death, and replayed jobs alike."""
+        door = self.serve_door
+        if door is None:
+            return
+        for job_id in door.job_ids():
+            with self._lock:
+                job = self._jobs.get(job_id)
+                if job is None:
+                    state: Optional[str] = DEAD
+                    ok, result, error = False, None, {
+                        "type": "UnknownJob",
+                        "message": "serve batch job vanished",
+                    }
+                elif job.state not in TERMINAL_STATES:
+                    continue
+                else:
+                    ok = job.state == SUCCEEDED
+                    result, error = job.result, job.error
+            completed = door.complete_job(
+                job_id, ok, result=result, error=error
+            )
+            if completed:
+                if ok and isinstance(result, dict):
+                    occ = result.get("occupancy")
+                    if isinstance(occ, (int, float)):
+                        self._m_serve_occupancy.set(float(occ))
+                self._note_serve_completions(completed)
+
+    def _note_serve_completions(self, completed: List[Any]) -> None:
+        """Metrics + SLO feed for requests that just reached a terminal
+        state: latency into the default objectives, TTFT into the
+        ``metric: "ttft"`` ones (the default spec's interactive_ttft)."""
+        now = self._clock()
+        for req in completed:
+            ok = req.state == SERVE_DONE
+            self._m_serve_requests.inc(
+                op=req.op, outcome="completed" if ok else "failed"
+            )
+            if req.latency_ms is not None:
+                self._m_serve_latency.observe(
+                    req.latency_ms / 1e3, op=req.op
+                )
+            if req.ttft_ms is not None:
+                self._m_serve_ttft.observe(req.ttft_ms / 1e3, op=req.op)
+            if req.tokens:
+                self._m_serve_tokens.inc(req.tokens, op=req.op)
+            self.recorder.record(
+                "serve_done", req_id=req.req_id, op=req.op,
+                outcome="completed" if ok else "failed",
+                ttft_ms=req.ttft_ms, latency_ms=req.latency_ms,
+            )
+            if self.slo is not None and req.latency_ms is not None:
+                self.slo.observe(
+                    req.latency_ms / 1e3, ok=ok, tier=req.priority,
+                    tenant=req.tenant, op=f"infer_{req.op}", now=now,
+                )
+                if req.ttft_ms is not None:
+                    self.slo.observe(
+                        req.ttft_ms / 1e3, ok=ok, tier=req.priority,
+                        tenant=req.tenant, op=f"infer_{req.op}", now=now,
+                        metric="ttft",
+                    )
+
+    def infer_snapshot(self, req_id: str) -> Optional[Dict[str, Any]]:
+        door = self.serve_door
+        return door.snapshot(req_id) if door is not None else None
+
+    def wait_infer(
+        self, req_id: str, timeout_sec: float
+    ) -> Optional[Dict[str, Any]]:
+        """Long-poll one request to a terminal state (or timeout). The wait
+        loop itself pumps the front door, so pure-HTTP traffic (no sweeper,
+        no polling agent yet) still deadline-flushes its buckets."""
+        door = self._require_serve()
+        slice_sec = max(0.005, self.serve_config.max_wait_ms / 2e3)
+        deadline = time.monotonic() + max(0.0, timeout_sec)
+        while True:
+            self._serve_pump()
+            remaining = deadline - time.monotonic()
+            snap = door.wait(req_id, min(max(remaining, 0.0), slice_sec))
+            if snap is None or snap["state"] in ("done", "failed") \
+                    or remaining <= 0:
+                return snap
+
+    def wait_infer_change(
+        self, req_id: str, last_state: str, timeout_sec: float
+    ) -> Optional[Dict[str, Any]]:
+        """Block until the request's state moves past ``last_state`` (or
+        timeout) — the chunked-streaming event source. Pumps like
+        :meth:`wait_infer`."""
+        door = self._require_serve()
+        slice_sec = max(0.005, self.serve_config.max_wait_ms / 2e3)
+        deadline = time.monotonic() + max(0.0, timeout_sec)
+        while True:
+            self._serve_pump()
+            remaining = deadline - time.monotonic()
+            snap = door.wait_change(
+                req_id, last_state, min(max(remaining, 0.0), slice_sec)
+            )
+            if snap is None or snap["state"] != last_state or remaining <= 0:
+                return snap
+
+    def serve_status(self) -> Dict[str, Any]:
+        """The ``/v1/status`` serving block (one schema enabled or not)."""
+        out: Dict[str, Any] = {"enabled": self.serve_door is not None}
+        if self.serve_door is not None:
+            out.update(self.serve_door.stats())
+        return out
 
     def note_http_bytes(self, route: str, direction: str, n: int) -> None:
         """Raw data-plane byte accounting, fed by the HTTP layer (request

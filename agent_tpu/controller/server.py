@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
@@ -55,6 +56,104 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _send_admission(self, exc: AdmissionError) -> None:
+        """Backpressure, not failure: 429 + retry_after_ms is the admission
+        contract — classify_http already calls 429 transient, so an
+        unmodified RetryPolicy backs off. Shared by /v1/jobs and /v1/infer."""
+        self.send_response(429)
+        data = json.dumps({
+            "error": str(exc),
+            "retry_after_ms": exc.retry_after_ms,
+            "tenant": exc.tenant,
+            "scope": exc.scope,
+        }).encode()
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header(
+            "Retry-After",
+            str(max(1, (exc.retry_after_ms + 999) // 1000)),
+        )
+        self.end_headers()
+        self.wfile.write(data)
+
+    # ---- online serving front door (ISSUE 15) ----
+
+    def _infer_wait_timeout(self, body: Dict[str, Any]) -> float:
+        """Client ``timeout_ms`` capped by the server's SERVE_WAIT_TIMEOUT."""
+        cap = self.controller.serve_config.wait_timeout_sec
+        raw = body.get("timeout_ms")
+        if isinstance(raw, (int, float)) and not isinstance(raw, bool) \
+                and raw > 0:
+            return min(float(raw) / 1e3, cap)
+        return cap
+
+    def _stream_infer(self, req_id: str, timeout_sec: float) -> None:
+        """Chunked NDJSON lifecycle stream: one JSON line per request state
+        (``queued`` → ``batched`` → ``done``/``failed``), the terminal line
+        carrying the result — the framing PROTOCOL.CONTRACT.md documents.
+        Manual chunked framing: BaseHTTPRequestHandler won't do it for us."""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj: Dict[str, Any]) -> None:
+            data = (json.dumps(obj, default=str) + "\n").encode()
+            self.wfile.write(
+                f"{len(data):x}\r\n".encode() + data + b"\r\n"
+            )
+            self.wfile.flush()
+
+        deadline = time.monotonic() + timeout_sec
+        snap = self.controller.infer_snapshot(req_id)
+        try:
+            while snap is not None:
+                chunk(snap)
+                if snap["state"] in ("done", "failed"):
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    chunk({"req_id": req_id, "state": snap["state"],
+                           "event": "timeout"})
+                    break
+                nxt = self.controller.wait_infer_change(
+                    req_id, snap["state"], remaining
+                )
+                if nxt is None or nxt["state"] == snap["state"]:
+                    continue
+                snap = nxt
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client hung up mid-stream; the request completes anyway
+
+    def _handle_infer_post(self, body: Dict[str, Any]) -> None:
+        try:
+            req_id = self.controller.submit_infer(
+                op=str(body.get("op", "")),
+                text=body.get("text"),
+                params=body.get("params")
+                if isinstance(body.get("params"), dict) else None,
+                tenant=(
+                    str(body["tenant"])
+                    if body.get("tenant") is not None else None
+                ),
+                priority=body.get("priority"),
+            )
+        except AdmissionError as exc:
+            self._send_admission(exc)
+            return
+        except (RuntimeError, KeyError, ValueError, TypeError) as exc:
+            disabled = isinstance(exc, RuntimeError)
+            self._send(501 if disabled else 400, {"error": str(exc)})
+            return
+        timeout = self._infer_wait_timeout(body)
+        if body.get("stream"):
+            self._stream_infer(req_id, timeout)
+        elif body.get("wait", True):
+            self._send(200, self.controller.wait_infer(req_id, timeout))
+        else:
+            self._send(200, {"req_id": req_id, "state": "queued"})
 
     def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
         body = self._read_json()
@@ -181,26 +280,15 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     self._send(200, {"job_id": job_id})
             except AdmissionError as exc:
-                # Backpressure, not failure: 429 + retry_after_ms is the
-                # admission-control contract — classify_http already calls
-                # 429 transient, so an unmodified RetryPolicy backs off.
-                self.send_response(429)
-                data = json.dumps({
-                    "error": str(exc),
-                    "retry_after_ms": exc.retry_after_ms,
-                    "tenant": exc.tenant,
-                    "scope": exc.scope,
-                }).encode()
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.send_header(
-                    "Retry-After",
-                    str(max(1, (exc.retry_after_ms + 999) // 1000)),
-                )
-                self.end_headers()
-                self.wfile.write(data)
+                self._send_admission(exc)
             except (KeyError, ValueError, TypeError) as exc:
                 self._send(400, {"error": str(exc)})
+        elif self.path == "/v1/infer":
+            # Online serving front door (ISSUE 15): one classify/summarize
+            # request; blocks to the result by default, ?wait:false returns
+            # the req_id for GET polling, stream:true frames the lifecycle
+            # as chunked NDJSON.
+            self._handle_infer_post(body)
         elif self.path == "/v1/profile/capture":
             # On-demand deep capture (ISSUE 9): arm one jax.profiler trace
             # on the named agent; the request rides its next granted lease.
@@ -322,6 +410,34 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/v1/profile/captures":
             self._send(200, self.controller.captures_json())
             return
+        if path.startswith("/v1/infer/"):
+            # Serving request status/result (ISSUE 15); ?wait_ms=N long-polls
+            # to a terminal state (capped by SERVE_WAIT_TIMEOUT_SEC).
+            req_id = path[len("/v1/infer/"):]
+            try:
+                wait_ms = (
+                    float(query["wait_ms"][0]) if "wait_ms" in query else 0.0
+                )
+            except ValueError:
+                self._send(400, {"error": "wait_ms must be a number"})
+                return
+            if wait_ms > 0:
+                try:
+                    snap = self.controller.wait_infer(
+                        req_id,
+                        min(wait_ms / 1e3,
+                            self.controller.serve_config.wait_timeout_sec),
+                    )
+                except RuntimeError as exc:  # serving disabled
+                    self._send(501, {"error": str(exc)})
+                    return
+            else:
+                snap = self.controller.infer_snapshot(req_id)
+            if snap is None:
+                self._send(404, {"error": f"unknown request {req_id!r}"})
+            else:
+                self._send(200, snap)
+            return
         if path == "/v1/health":
             # Fleet health verdict (ISSUE 8): per-tier SLO attainment +
             # burn-rate alert states, per-agent duty cycle/MFU/liveness,
@@ -347,6 +463,9 @@ class _Handler(BaseHTTPRequestHandler):
                     # replay's duration — the O(live state) claim as a
                     # number operators can read off one status call.
                     "journal": self.controller.journal_status(),
+                    # Serving front-door block (ISSUE 15): request states,
+                    # open buckets, in-flight batch jobs, 429 drops.
+                    "serving": self.controller.serve_status(),
                     "last_metrics": self.controller.last_metrics,
                 },
             )
@@ -430,6 +549,7 @@ def main() -> int:
         JournalConfig,
         ObsConfig,
         SchedConfig,
+        ServeConfig,
         SloConfig,
         env_bool,
         env_float,
@@ -463,6 +583,9 @@ def main() -> int:
         # compacting snapshots, optional fdatasync. Defaults reproduce the
         # historical single-file journal byte for byte.
         journal=JournalConfig.from_env(),
+        # SERVE_* knobs (ISSUE 15): the POST /v1/infer front door —
+        # coalescing deadline/batch caps, length buckets, admission budget.
+        serve=ServeConfig.from_env(),
     )
     server = ControllerServer(controller, host=host, port=port)
     stop = threading.Event()

@@ -49,7 +49,14 @@ class TrafficClass:
     """One class of offered work. ``payload`` is the static template;
     ``payload_fn(rng, seq)`` (when given) builds a per-arrival payload from
     the generator's seeded rng and the arrival sequence number, so payload
-    variety stays deterministic too."""
+    variety stays deterministic too.
+
+    ``route`` picks the submission surface (ISSUE 15): ``"jobs"`` (the
+    batch queue, ``POST /v1/jobs`` — the historical shape) or ``"infer"``
+    (the serving front door, ``POST /v1/infer``). An infer class's ``op``
+    is the REQUEST op (``classify``/``summarize``) and its payload carries
+    ``{"text": ..., "params": {...}}`` — one traffic driver for
+    elastic_soak's job churn and the serving bench's interactive load."""
 
     name: str
     op: str
@@ -59,6 +66,13 @@ class TrafficClass:
     deadline_sec: Optional[float] = None
     payload: Dict[str, Any] = field(default_factory=dict)
     payload_fn: Optional[Callable[[random.Random, int], Dict[str, Any]]] = None
+    route: str = "jobs"   # "jobs" | "infer"
+
+    def __post_init__(self) -> None:
+        if self.route not in ("jobs", "infer"):
+            raise ValueError(
+                f"route must be 'jobs' or 'infer', got {self.route!r}"
+            )
 
     def build_payload(self, rng: random.Random, seq: int) -> Dict[str, Any]:
         if self.payload_fn is not None:
@@ -249,20 +263,36 @@ def session_submitter(
 ) -> Callable[[Arrival], str]:
     """Adapt any ``session.post``-shaped transport (``requests.Session``,
     ``chaos.LoopbackSession``) into the submit callable ``LoadGen.run``
-    expects, POSTing each arrival to ``{base_url}/v1/jobs`` with the
-    class's tenant/priority/deadline riding the body. 429 → :class:`Rejected`
-    (open-loop drop); any other non-200 raises."""
-    url = f"{base_url.rstrip('/')}/v1/jobs"
+    expects. ``route="jobs"`` classes POST to ``{base_url}/v1/jobs``
+    (tenant/priority/deadline riding the body, job_id back);
+    ``route="infer"`` classes POST to the serving front door
+    ``{base_url}/v1/infer`` non-blocking (``wait: false``, req_id back) —
+    open loop both ways. 429 → :class:`Rejected` (open-loop drop); any
+    other non-200 raises."""
+    base = base_url.rstrip("/")
+    jobs_url = f"{base}/v1/jobs"
+    infer_url = f"{base}/v1/infer"
 
     def submit(arrival: Arrival) -> str:
         cls = arrival.cls
-        body: Dict[str, Any] = {"op": cls.op, "payload": arrival.payload}
+        if cls.route == "infer":
+            body: Dict[str, Any] = {
+                "op": cls.op,
+                "text": arrival.payload.get("text"),
+                "wait": False,
+            }
+            if isinstance(arrival.payload.get("params"), dict):
+                body["params"] = arrival.payload["params"]
+            id_key, url = "req_id", infer_url
+        else:
+            body = {"op": cls.op, "payload": arrival.payload}
+            if cls.deadline_sec is not None:
+                body["deadline_sec"] = cls.deadline_sec
+            id_key, url = "job_id", jobs_url
         if cls.tenant is not None:
             body["tenant"] = cls.tenant
         if cls.priority is not None:
             body["priority"] = cls.priority
-        if cls.deadline_sec is not None:
-            body["deadline_sec"] = cls.deadline_sec
         resp = session.post(url, json=body, timeout=10.0)
         status = getattr(resp, "status_code", 0)
         if status == 429:
@@ -271,9 +301,9 @@ def session_submitter(
             raise RuntimeError(
                 f"submit {cls.name!r} failed: HTTP {status}"
             )
-        job_id = resp.json().get("job_id")
-        if not isinstance(job_id, str) or not job_id:
+        out_id = resp.json().get(id_key)
+        if not isinstance(out_id, str) or not out_id:
             raise RuntimeError(f"submit {cls.name!r}: malformed response")
-        return job_id
+        return out_id
 
     return submit

@@ -13,14 +13,24 @@ can never drift between families.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from agent_tpu.models.layers import NEG_INF
 
 StepFn = Callable[[jax.Array, jax.Array, Any], Tuple[jax.Array, Any]]
+
+# The continuous engine's per-row step: positions are a [rows] vector (each
+# running-batch slot sits at its own decode depth) and the encoder state is
+# an argument (slots join with their own prefill output).
+PositionalStepFn = Callable[
+    [jax.Array, jax.Array, Any, jax.Array, jax.Array], Tuple[jax.Array, Any]
+]
 
 
 
@@ -38,6 +48,36 @@ def _ban_eos_before(scores, step, min_length: int, eos_id: int):
         & (jnp.arange(v) == eos_id).reshape(lead + (v,)),
         NEG_INF, scores,
     )
+
+
+def _ban_eos_before_rows(scores, pos, min_length: int, eos_id: int):
+    """Per-row variant of :func:`_ban_eos_before` for the continuous engine:
+    ``scores`` [S, ..., V], ``pos`` [S] per-slot step indices. Same masking
+    values per row as the scalar version at that row's step."""
+    if min_length <= 0:
+        return scores
+    v = scores.shape[-1]
+    cond = (pos + 1 < min_length).reshape(
+        (scores.shape[0],) + (1,) * (scores.ndim - 1)
+    )
+    return jnp.where(
+        cond & (jnp.arange(v) == eos_id).reshape(
+            (1,) * (scores.ndim - 1) + (v,)
+        ),
+        NEG_INF, scores,
+    )
+
+
+def _bank_hypotheses(K: int, fin_scores, fin_toks, cand_norm, cand_toks):
+    """Merge candidate hypotheses into the K-slot finished store (shared by
+    ``beam_scan`` and the continuous engine so banking can never drift).
+    ``cand_norm`` [B, n] (``-inf`` = ineligible — it must be -inf, see the
+    ``beam_scan`` initializer note), ``cand_toks`` [B, n, T]."""
+    all_scores = jnp.concatenate([fin_scores, cand_norm], axis=1)
+    all_toks = jnp.concatenate([fin_toks, cand_toks], axis=1)
+    new_scores, sel = jax.lax.top_k(all_scores, K)          # [B, K]
+    new_toks = jnp.take_along_axis(all_toks, sel[:, :, None], axis=1)
+    return new_scores, new_toks
 
 
 def greedy_scan(
@@ -203,14 +243,8 @@ def beam_scan(
     lp = jnp.float32(length_penalty)
 
     def bank(fin_scores, fin_toks, cand_norm, cand_toks):
-        """Merge candidate hypotheses into the K-slot finished store.
-        cand_norm [B, n] (``_EMPTY`` = ineligible — it must be -inf, see
-        the initializer note), cand_toks [B, n, T]."""
-        all_scores = jnp.concatenate([fin_scores, cand_norm], axis=1)
-        all_toks = jnp.concatenate([fin_toks, cand_toks], axis=1)
-        new_scores, sel = jax.lax.top_k(all_scores, K)          # [B, K]
-        new_toks = jnp.take_along_axis(all_toks, sel[:, :, None], axis=1)
-        return new_scores, new_toks
+        """``_bank_hypotheses`` at this decode's K (see module level)."""
+        return _bank_hypotheses(K, fin_scores, fin_toks, cand_norm, cand_toks)
 
     def body(carry, step):
         tok, scores, toks, fin_scores, fin_toks, row_done, caches = carry
@@ -348,3 +382,484 @@ def beam_scan(
     out = fin_toks[:, 0]                                        # [B, T]
     out_len = jnp.sum((out != pad_id) & (out != eos_id), axis=1)
     return out, out_len
+
+
+# ---------------------------------------------------------------------------
+# Iteration-level continuous batching (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+class DecodeTicket:
+    """One request's seat in the continuous engine: the prefill handoff in,
+    the emitted tokens (and TTFT/occupancy bookkeeping) out."""
+
+    __slots__ = (
+        "data", "limit", "enc_row", "mask_row", "slot",
+        "admitted_wall", "joined_wall", "first_token_wall", "done_wall",
+        "tokens", "length", "steps",
+    )
+
+    def __init__(self, enc_row, mask_row, limit: int, data: Any = None):
+        self.data = data
+        self.limit = int(limit)
+        self.enc_row = enc_row
+        self.mask_row = mask_row
+        self.slot: Optional[int] = None
+        self.admitted_wall: Optional[float] = None
+        self.joined_wall: Optional[float] = None
+        self.first_token_wall: Optional[float] = None
+        self.done_wall: Optional[float] = None
+        self.tokens: Optional[np.ndarray] = None
+        self.length: int = 0
+        self.steps: int = 0
+
+
+class ContinuousBatcher:
+    """Iteration-level continuous batching over a fixed-capacity slot batch.
+
+    The scan engines above compile ONE program per decode: a batch enters
+    together and (early exit aside) pays for its slowest row. Serving traffic
+    is the opposite shape — requests arrive continuously — so this engine
+    keeps a *running* batch of ``slots`` requests (× ``num_beams`` beam rows
+    each) and drives ONE jitted step program per decode iteration:
+
+    - finished sequences **exit between steps** (their slot frees the moment
+      the per-slot done flag trips — EOS/banked-full for beam, EOS or the
+      per-slot token ``limit`` for greedy);
+    - queued sequences **join between steps** via a jitted slot-insertion
+      (``dynamic_update_slice`` of the new request's prefill output + a
+      zeroed KV block — the same delta-style "touch only what changed"
+      discipline as the PR 1 cache reorder, so a join never rewrites the
+      running batch);
+    - every slot carries its own position vector, so the decode math per
+      slot is bit-identical to a solo ``greedy_scan``/``beam_scan`` of that
+      request (regression-tested in tests/test_serving.py).
+
+    Prefill is NOT this engine's job: callers encode (batched, as its own
+    step — the ``summarize_mpmd`` encoded handoff) and admit
+    ``(enc_row, mask_row)`` per request. ``step_fn`` is a
+    :data:`PositionalStepFn` (e.g. ``seq2seq.make_positional_step``).
+
+    Host loop by design: one jitted step per iteration, state threaded
+    through with buffer donation where the backend supports it. That trades
+    the scan engines' zero host round-trips for the ability to mutate batch
+    membership — the defining trade of continuous-batching serving stacks.
+    """
+
+    def __init__(
+        self,
+        step_fn: PositionalStepFn,
+        cache_factory: Callable[[int], Any],
+        *,
+        slots: int,
+        vocab_size: int,
+        max_tokens: int,
+        enc_len: int,
+        d_model: int,
+        start_id: int,
+        eos_id: int,
+        pad_id: int = 0,
+        num_beams: int = 1,
+        min_length: int = 0,
+        length_penalty: float = 1.0,
+        early_stopping: bool = False,
+        cache_reorder: str = "delta",
+        enc_dtype: Any = jnp.float32,
+        micro_steps: int = 1,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        if slots < 1:
+            raise ValueError("slots must be >= 1")
+        if num_beams < 1:
+            raise ValueError("num_beams must be >= 1")
+        if micro_steps < 1:
+            raise ValueError("micro_steps must be >= 1")
+        if cache_reorder not in ("delta", "gather"):
+            raise ValueError(
+                f"cache_reorder must be 'delta' or 'gather', "
+                f"got {cache_reorder!r}"
+            )
+        self.step_fn = step_fn
+        self.slots = int(slots)
+        self.K = int(num_beams)
+        self.V = int(vocab_size)
+        self.T = int(max_tokens)
+        self.enc_len = int(enc_len)
+        self.start_id = int(start_id)
+        self.eos_id = int(eos_id)
+        self.pad_id = int(pad_id)
+        self.min_length = int(min_length)
+        self.length_penalty = float(length_penalty)
+        self.early_stopping = bool(early_stopping)
+        self.cache_reorder = cache_reorder
+        self.beam = self.K > 1
+        # Decode iterations fused per dispatch: 1 (default) is pure
+        # iteration-level batching — membership can change between every
+        # step. Dispatch-overhead-bound deployments (small models, CPU
+        # smoke, tunneled chips) raise it: N iterations run as one jitted
+        # ``fori_loop`` program (XLA reuses buffers across the chained
+        # updates, recovering most of the scan engines' zero-overhead
+        # stepping), and joins/exits happen between CHUNKS — completed
+        # slots ride out the remainder of a chunk frozen, exactly like
+        # empty slots, so per-request outputs are unchanged.
+        self.micro_steps = int(micro_steps)
+        self._clock = clock
+        S, K, T, R = self.slots, self.K, self.T, self.slots * self.K
+        # State is split DYNAMIC vs STATIC: the jitted step returns only the
+        # dynamic part, so per-iteration buffer traffic on backends without
+        # donation (CPU) excludes the encoder block and per-slot limits —
+        # they change only at joins, through the insert program.
+        dyn: Dict[str, Any] = {
+            "tok": jnp.full((R,), self.start_id, dtype=jnp.int32),
+            "pos": jnp.zeros((S,), dtype=jnp.int32),
+            # Empty slots are frozen rows (`row_done`): they ride every step
+            # as pads + identity reorders and reset on insertion.
+            "row_done": jnp.ones((S,), dtype=jnp.bool_),
+            "caches": cache_factory(R),
+        }
+        if self.beam:
+            dyn["scores"] = jnp.tile(
+                jnp.array([0.0] + [NEG_INF] * (K - 1), dtype=jnp.float32),
+                (S, 1),
+            )
+            dyn["toks"] = jnp.full((S, K, T), self.pad_id, dtype=jnp.int32)
+            dyn["fin_scores"] = jnp.full(
+                (S, K), -jnp.inf, dtype=jnp.float32
+            )
+            dyn["fin_toks"] = jnp.full(
+                (S, K, T), self.pad_id, dtype=jnp.int32
+            )
+        else:
+            dyn["toks"] = jnp.full((S, T), self.pad_id, dtype=jnp.int32)
+        self._dyn = dyn
+        self._stat: Dict[str, Any] = {
+            "limit": jnp.ones((S,), dtype=jnp.int32),
+            "enc_out": jnp.zeros((R, self.enc_len, d_model), dtype=enc_dtype),
+            "enc_mask": jnp.zeros((R, self.enc_len), dtype=jnp.int32),
+        }
+        # Buffer donation makes the step/insert updates in-place on backends
+        # that support it; CPU copies and warns — silence the known-benign
+        # warning rather than fork the code path.
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        step_impl = self._step_beam if self.beam else self._step_greedy
+        if self.micro_steps > 1:
+            n = self.micro_steps
+
+            def chunk(dyn, stat):
+                return jax.lax.fori_loop(
+                    0, n, lambda _i, d: step_impl(d, stat), dyn
+                )
+
+            self._jstep = jax.jit(chunk, donate_argnums=0)
+        else:
+            self._jstep = jax.jit(step_impl, donate_argnums=0)
+        self._jinsert = jax.jit(self._insert, donate_argnums=(0, 1))
+        self._live: Dict[int, DecodeTicket] = {}
+        self._free: List[int] = list(range(S))
+        self._backlog: List[DecodeTicket] = []
+        # Occupancy accounting (the `serve_batch_occupancy` gauge feed).
+        self.steps_run = 0
+        self.occupancy_sum = 0
+        self.max_occupancy = 0
+        self.tokens_emitted = 0
+
+    # ---- jitted programs ----
+
+    def _step_greedy(
+        self, state: Dict[str, Any], stat: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        S, T = self.slots, self.T
+        pos, row_done = state["pos"], state["row_done"]
+        logits, caches = self.step_fn(
+            state["tok"], pos, state["caches"],
+            stat["enc_out"], stat["enc_mask"],
+        )
+        logits = _ban_eos_before_rows(
+            logits, pos, self.min_length, self.eos_id
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(row_done, jnp.int32(self.pad_id), nxt)
+        # Frozen slots write out of bounds → dropped (their buffers must
+        # survive untouched until the host extracts / the slot reseats).
+        col = jnp.where(row_done, jnp.int32(T), pos)
+        toks = state["toks"].at[jnp.arange(S), col].set(nxt, mode="drop")
+        new_pos = jnp.where(row_done, pos, pos + 1)
+        new_done = row_done | (nxt == self.eos_id) | (new_pos >= stat["limit"])
+        return dict(
+            state, tok=nxt, pos=new_pos, row_done=new_done, toks=toks,
+            caches=caches,
+        )
+
+    def _step_beam(
+        self, state: Dict[str, Any], stat: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """One continuous-batching beam step — ``beam_scan``'s body with the
+        scalar step replaced by the per-slot ``pos`` vector, plus the
+        per-slot limit banking the scan engine does after its loop."""
+        S, K, V, T = self.slots, self.K, self.V, self.T
+        K2 = 2 * K
+        lp = jnp.float32(self.length_penalty)
+        _EMPTY = jnp.float32(-jnp.inf)
+        pos, row_done = state["pos"], state["row_done"]
+        scores, toks = state["scores"], state["toks"]
+        fin_scores, fin_toks = state["fin_scores"], state["fin_toks"]
+
+        pos_rows = jnp.repeat(pos, K)
+        logits, caches = self.step_fn(
+            state["tok"], pos_rows, state["caches"],
+            stat["enc_out"], stat["enc_mask"],
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(S, K, V)
+        logp = _ban_eos_before_rows(logp, pos, self.min_length, self.eos_id)
+        flat = (scores[:, :, None] + logp).reshape(S, K * V)
+        cand_scores, idx = jax.lax.top_k(flat, K2)    # [S, 2K]
+        cand_beam = idx // V
+        cand_tok = (idx % V).astype(jnp.int32)
+        is_eos = cand_tok == self.eos_id
+
+        # Bank EOS candidates (HF: ranks < K, open rows only); hypothesis
+        # length is per-slot now — (pos + 1) generated tokens incl. the EOS's
+        # predecessor, the same counting beam_scan uses.
+        hyp_len = (pos + 1).astype(jnp.float32)       # [S]
+        eligible = (
+            is_eos & (jnp.arange(K2)[None, :] < K) & ~row_done[:, None]
+        )
+        cand_norm = jnp.where(
+            eligible, cand_scores / hyp_len[:, None] ** lp, _EMPTY
+        )
+        par_toks = jnp.take_along_axis(toks, cand_beam[:, :, None], axis=1)
+        col = jnp.where(row_done, jnp.int32(T), pos)  # frozen → dropped write
+        cand_toks = par_toks.at[jnp.arange(S), :, col].set(
+            jnp.int32(self.eos_id), mode="drop"
+        )
+        fin_scores, fin_toks = _bank_hypotheses(
+            K, fin_scores, fin_toks, cand_norm, cand_toks
+        )
+
+        # Continue with the K best non-EOS candidates (see beam_scan for why
+        # K always exist); frozen slots keep their own beams (identity).
+        _, gather_pos = jax.lax.top_k(
+            jnp.where(is_eos, -jnp.inf, cand_scores), K
+        )
+        new_scores = jnp.take_along_axis(cand_scores, gather_pos, axis=1)
+        new_tok = jnp.take_along_axis(cand_tok, gather_pos, axis=1)
+        beam_idx = jnp.take_along_axis(cand_beam, gather_pos, axis=1)
+        arange_k = jnp.arange(K, dtype=jnp.int32)[None, :]
+        new_scores = jnp.where(row_done[:, None], scores, new_scores)
+        new_tok = jnp.where(
+            row_done[:, None], jnp.int32(self.pad_id), new_tok
+        )
+        beam_idx = jnp.where(row_done[:, None], arange_k, beam_idx)
+
+        toks = jnp.take_along_axis(toks, beam_idx[:, :, None], axis=1)
+        toks = toks.at[jnp.arange(S), :, col].set(new_tok, mode="drop")
+
+        # HF is_done, per slot (beam_scan's rule verbatim).
+        full = jnp.isfinite(fin_scores[:, K - 1])
+        if self.early_stopping:
+            newly_done = full
+        else:
+            best_running = new_scores[:, 0] / hyp_len ** lp
+            newly_done = full & (best_running <= fin_scores[:, K - 1])
+        row_done2 = row_done | newly_done
+
+        # Per-slot limit: a slot that ran out of budget banks its running
+        # beams normalized by its OWN generated length — exactly the
+        # post-loop banking a solo beam_scan(max_new=limit) performs.
+        new_pos = jnp.where(row_done, pos, pos + 1)
+        reached = (new_pos >= stat["limit"]) & ~row_done2
+        run_norm = jnp.where(
+            reached[:, None],
+            new_scores / stat["limit"].astype(jnp.float32)[:, None] ** lp,
+            _EMPTY,
+        )
+        fin_scores, fin_toks = _bank_hypotheses(
+            K, fin_scores, fin_toks, run_norm, toks
+        )
+        row_done2 = row_done2 | reached
+
+        def reorder(c):
+            x = c.reshape(S, K, *c.shape[1:])
+            ix = beam_idx.reshape(S, K, *([1] * (c.ndim - 1)))
+            return jnp.take_along_axis(x, ix, axis=1).reshape(c.shape)
+
+        def reorder_all(cs):
+            return jax.tree_util.tree_map(reorder, cs)
+
+        if self.cache_reorder == "gather":
+            caches = reorder_all(caches)
+        else:
+            # Delta reorder (PR 1): frozen/empty slots are identity, so a
+            # steady-state running batch frequently skips the full-cache
+            # gather — the property that keeps joins cheap.
+            caches = jax.lax.cond(
+                jnp.all(beam_idx == arange_k),
+                lambda cs: cs, reorder_all, caches,
+            )
+        return dict(
+            state, tok=new_tok.reshape(S * K), pos=new_pos,
+            row_done=row_done2, scores=new_scores, toks=toks,
+            fin_scores=fin_scores, fin_toks=fin_toks, caches=caches,
+        )
+
+    def _insert(self, state, stat, slot, enc_row, mask_row, limit):
+        """Seat one request in ``slot``: prefill output in, KV block zeroed,
+        per-slot decode state reset. All `dynamic_update_slice`/scatter —
+        the running batch's other slots are never touched."""
+        K, T = self.K, self.T
+        r0 = slot * K
+        enc_out = jax.lax.dynamic_update_slice(
+            stat["enc_out"],
+            jnp.broadcast_to(
+                enc_row[None], (K,) + enc_row.shape
+            ).astype(stat["enc_out"].dtype),
+            (r0, 0, 0),
+        )
+        enc_mask = jax.lax.dynamic_update_slice(
+            stat["enc_mask"],
+            jnp.broadcast_to(
+                mask_row[None], (K,) + mask_row.shape
+            ).astype(jnp.int32),
+            (r0, 0),
+        )
+        new_stat = dict(
+            stat, enc_out=enc_out, enc_mask=enc_mask,
+            limit=stat["limit"].at[slot].set(limit),
+        )
+
+        def zero_rows(c):
+            z = jnp.zeros((K,) + c.shape[1:], dtype=c.dtype)
+            return jax.lax.dynamic_update_slice(
+                c, z, (r0,) + (0,) * (c.ndim - 1)
+            )
+
+        caches = jax.tree_util.tree_map(zero_rows, state["caches"])
+        tok = jax.lax.dynamic_update_slice(
+            state["tok"],
+            jnp.full((K,), self.start_id, dtype=jnp.int32),
+            (r0,),
+        )
+        out = dict(state, caches=caches, tok=tok)
+        out["pos"] = state["pos"].at[slot].set(0)
+        out["row_done"] = state["row_done"].at[slot].set(False)
+        if self.beam:
+            out["scores"] = state["scores"].at[slot].set(
+                jnp.array(
+                    [0.0] + [NEG_INF] * (K - 1), dtype=jnp.float32
+                )
+            )
+            out["toks"] = state["toks"].at[slot].set(
+                jnp.full((K, T), self.pad_id, dtype=jnp.int32)
+            )
+            out["fin_scores"] = state["fin_scores"].at[slot].set(
+                jnp.full((K,), -jnp.inf, dtype=jnp.float32)
+            )
+            out["fin_toks"] = state["fin_toks"].at[slot].set(
+                jnp.full((K, T), self.pad_id, dtype=jnp.int32)
+            )
+        else:
+            out["toks"] = state["toks"].at[slot].set(
+                jnp.full((T,), self.pad_id, dtype=jnp.int32)
+            )
+        return out, new_stat
+
+    # ---- host loop ----
+
+    @property
+    def occupancy(self) -> int:
+        """Requests currently seated in the running batch."""
+        return len(self._live)
+
+    @property
+    def backlog(self) -> int:
+        return len(self._backlog)
+
+    def has_work(self) -> bool:
+        return bool(self._live or self._backlog)
+
+    def mean_occupancy(self) -> float:
+        if not self.steps_run:
+            return 0.0
+        return self.occupancy_sum / self.steps_run
+
+    def admit(
+        self, enc_row, mask_row, limit: int, data: Any = None
+    ) -> DecodeTicket:
+        """Queue one request (prefill output + per-request token budget).
+        Joins the running batch immediately if a slot is free, else waits in
+        the backlog and joins between steps as slots free up."""
+        limit = max(1, min(int(limit), self.T))
+        ticket = DecodeTicket(enc_row, mask_row, limit, data=data)
+        ticket.admitted_wall = self._clock()
+        self._backlog.append(ticket)
+        self._fill_slots()
+        return ticket
+
+    def _fill_slots(self) -> None:
+        while self._free and self._backlog:
+            ticket = self._backlog.pop(0)
+            slot = self._free.pop(0)
+            self._dyn, self._stat = self._jinsert(
+                self._dyn, self._stat, np.int32(slot),
+                jnp.asarray(ticket.enc_row), jnp.asarray(ticket.mask_row),
+                np.int32(ticket.limit),
+            )
+            ticket.slot = slot
+            ticket.joined_wall = self._clock()
+            ticket.enc_row = ticket.mask_row = None  # joined: free the host copy
+            self._live[slot] = ticket
+
+    def _extract(self, slot: int) -> Tuple[np.ndarray, int]:
+        if self.beam:
+            out = np.asarray(self._dyn["fin_toks"][slot, 0])
+        else:
+            out = np.asarray(self._dyn["toks"][slot])
+        length = int(
+            ((out != self.pad_id) & (out != self.eos_id)).sum()
+        )
+        return out, length
+
+    def step(self) -> List[DecodeTicket]:
+        """One decode iteration of the running batch. Returns the tickets
+        that finished this step (their slots are already reseated from the
+        backlog — the join happens between steps, never inside one)."""
+        if not self._live:
+            self._fill_slots()
+            if not self._live:
+                return []
+        self._dyn = self._jstep(self._dyn, self._stat)
+        self.steps_run += self.micro_steps
+        self.occupancy_sum += len(self._live) * self.micro_steps
+        self.max_occupancy = max(self.max_occupancy, len(self._live))
+        pos = np.asarray(self._dyn["pos"])
+        done = np.asarray(self._dyn["row_done"])
+        now = self._clock()
+        finished: List[DecodeTicket] = []
+        for slot, ticket in list(self._live.items()):
+            if ticket.first_token_wall is None and pos[slot] >= 1:
+                ticket.first_token_wall = now
+            if done[slot]:
+                ticket.steps = int(pos[slot])
+                ticket.tokens, ticket.length = self._extract(slot)
+                ticket.done_wall = now
+                self.tokens_emitted += max(ticket.steps, ticket.length)
+                del self._live[slot]
+                self._free.append(slot)
+                finished.append(ticket)
+        if finished:
+            self._fill_slots()
+        return finished
+
+    def run(self, tickets: List[DecodeTicket]) -> None:
+        """Pump until every ticket in ``tickets`` finished — the monolithic
+        (non-pipelined) path; the pipelined serving loop interleaves
+        :meth:`step` with admissions instead."""
+        pending = {id(t) for t in tickets if t.done_wall is None}
+        while pending:
+            for t in self.step():
+                pending.discard(id(t))
+            if not self.has_work() and pending:
+                raise RuntimeError(
+                    "continuous engine drained with tickets outstanding"
+                )

@@ -163,6 +163,11 @@ def attention(
     static-shape decode pattern that keeps ``lax.scan`` from retracing
     (SURVEY.md §7 "hard parts": decode doesn't retrace per step).
 
+    A **vector** ``cache_index`` ([B] int32) writes each row's K/V at its own
+    position — the continuous-batching decode case (ISSUE 15), where slots in
+    a running batch sit at different decode depths. The written values are
+    identical to the scalar path's; only the addressing generalizes.
+
     ``attn_fn`` is the inner attention kernel — the sp ring path
     (``agent_tpu.parallel.ring.ring_attention``) substitutes here.
     """
@@ -172,13 +177,26 @@ def attention(
 
     if cache is not None:
         assert cache_index is not None
-        zero = jnp.zeros((), dtype=jnp.int32)
-        k = jax.lax.dynamic_update_slice(
-            cache["k"].astype(dtype), k, (zero, zero, cache_index, zero)
-        )
-        v = jax.lax.dynamic_update_slice(
-            cache["v"].astype(dtype), v, (zero, zero, cache_index, zero)
-        )
+        if getattr(cache_index, "ndim", 0) == 1:
+            # Per-row positions: one decode step (Lk == 1) written to each
+            # row's own cache slot. Formulated as a one-hot select, NOT a
+            # gather/scatter — XLA lowers scatters to element loops on some
+            # backends (measured 3× per-step cost on CPU), while the dense
+            # where is a single vectorized pass over the cache.
+            sel = (
+                jnp.arange(cache["k"].shape[2])[None, :]
+                == cache_index[:, None]
+            )[:, None, :, None]                       # [B, 1, Lmax, 1]
+            k = jnp.where(sel, k, cache["k"].astype(dtype))
+            v = jnp.where(sel, v, cache["v"].astype(dtype))
+        else:
+            zero = jnp.zeros((), dtype=jnp.int32)
+            k = jax.lax.dynamic_update_slice(
+                cache["k"].astype(dtype), k, (zero, zero, cache_index, zero)
+            )
+            v = jax.lax.dynamic_update_slice(
+                cache["v"].astype(dtype), v, (zero, zero, cache_index, zero)
+            )
         cache = {"k": k, "v": v}
 
     out = attn_fn(q, k, v, mask)

@@ -105,21 +105,36 @@ def _empty_cache(cfg: Seq2SeqConfig, batch: int) -> list:
 def _decode_step(
     params: Params,
     tok: jax.Array,           # [B] current input token
-    step: jax.Array,          # scalar int32 position
+    step: jax.Array,          # scalar int32 position, or [B] per-row positions
     enc_out: jax.Array,       # [B, Ls, d]
     enc_mask: jax.Array,      # [B, Ls]
     caches: list,
     cfg: Seq2SeqConfig,
 ) -> Tuple[jax.Array, list]:
-    """One decoder step over the KV cache; returns (logits [B, V], caches)."""
+    """One decoder step over the KV cache; returns (logits [B, V], caches).
+
+    ``step`` may be a **[B] vector** of per-row positions — the continuous-
+    batching case (ISSUE 15), where each running-batch slot sits at its own
+    decode depth. The per-row math (position embedding gather, per-row
+    causal mask, per-row cache scatter) computes exactly the values the
+    scalar path computes for a batch whose rows all share one position, so
+    a slot's step stream is bit-identical to a solo scalar-step decode.
+    """
     dtype = cfg.compute_dtype
     x = params["embed"].astype(dtype)[tok][:, None, :]  # [B, 1, d]
-    x = x + jax.lax.dynamic_slice_in_dim(
-        params["pos"].astype(dtype), step, 1, axis=0
-    )[None]
-    # Self-attention mask: attend to cache positions <= step.
     positions = jnp.arange(cfg.max_tgt_len)
-    self_mask = (positions <= step).astype(jnp.int32)[None, None, None, :]
+    if getattr(step, "ndim", 0) == 1:
+        x = x + params["pos"].astype(dtype)[step][:, None, :]
+        # Per-row causal mask: row b attends to cache positions <= step[b].
+        self_mask = (
+            positions[None, :] <= step[:, None]
+        ).astype(jnp.int32)[:, None, None, :]
+    else:
+        x = x + jax.lax.dynamic_slice_in_dim(
+            params["pos"].astype(dtype), step, 1, axis=0
+        )[None]
+        # Self-attention mask: attend to cache positions <= step.
+        self_mask = (positions <= step).astype(jnp.int32)[None, None, None, :]
     enc_attn_mask = enc_mask[:, None, None, :]
     new_caches = []
     for block, cache in zip(params["dec"], caches):
@@ -242,6 +257,32 @@ def beam_generate(
         length_penalty=length_penalty, early_stopping=early_stopping,
         min_length=min_length,
     )
+
+
+def make_positional_step(params: Params, cfg: Seq2SeqConfig):
+    """The per-row-position decode step the continuous-batching engine
+    (``models.decoding.ContinuousBatcher``) drives: unlike the scan engines'
+    closures, the encoder state is an ARGUMENT, because slots join a running
+    batch with their own encoder output (the prefill/decode split — prefill
+    produced ``enc_out`` earlier, possibly on another agent, cf.
+    ``greedy_generate_from_encoded``)."""
+
+    def step_fn(tok, pos_rows, caches, enc_out, enc_mask):
+        return _decode_step(
+            params, tok, pos_rows, enc_out.astype(cfg.compute_dtype),
+            enc_mask, caches, cfg,
+        )
+
+    return step_fn
+
+
+def make_cache_factory(cfg: Seq2SeqConfig):
+    """``rows -> empty KV caches`` for the continuous engine's slot store."""
+
+    def factory(rows: int) -> list:
+        return _empty_cache(cfg, rows)
+
+    return factory
 
 
 def load_npz(path: str, cfg: Seq2SeqConfig) -> Params:

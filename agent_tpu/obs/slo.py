@@ -58,14 +58,24 @@ from agent_tpu.obs.metrics import (
 STATES = ("ok", "warn", "page")
 _RANK = {s: i for i, s in enumerate(STATES)}
 
-# The built-in objective when SLO_SPEC is unset: judge the interactive
+# The built-in objectives when SLO_SPEC is unset: judge the interactive
 # priority tier (ISSUE 4's tier 8+ = urgent class) on tail latency and
-# availability. Deliberately generous (1s p99) — a default must not page a
-# healthy bulk-oriented deployment; operators tighten it per deployment.
+# availability, plus — ISSUE 15 — the serving path's time-to-first-token
+# (``metric: "ttft"``, fed by the controller's /v1/infer completion
+# fan-out; nothing else observes that metric, so the objective idles on
+# batch-only deployments). Deliberately generous (1s p99 latency, 2.5s
+# p99 TTFT) — a default must not page a healthy bulk-oriented deployment;
+# operators tighten it per deployment.
 DEFAULT_SLO_SPEC = (
     '[{"name": "interactive", "tier": 8, "p99_ms": 1000, '
-    '"availability": 0.999}]'
+    '"availability": 0.999},'
+    ' {"name": "interactive_ttft", "tier": 8, "metric": "ttft", '
+    '"p99_ms": 2500, "availability": 0.999}]'
 )
+
+# Observation streams an objective may judge: submit→apply latency (the
+# default every terminal job feeds) or serving time-to-first-token.
+METRICS = ("latency", "ttft")
 
 # Latency percentile keys the spec may carry: "p50_ms" → quantile 0.50.
 _PCTL_KEYS = (("p50_ms", 0.50), ("p95_ms", 0.95), ("p99_ms", 0.99))
@@ -86,8 +96,17 @@ class Objective:
     p95_ms: Optional[float] = None
     p99_ms: Optional[float] = None
     availability: Optional[float] = None
+    # Which observation stream this objective judges (ISSUE 15): "latency"
+    # (submit→apply, the historical stream) or "ttft" (serving
+    # time-to-first-token). An objective only sees observations of its own
+    # metric — a TTFT target never judges batch-job latencies.
+    metric: str = "latency"
 
-    def matches(self, tier: Any, tenant: Any, op: Any) -> bool:
+    def matches(
+        self, tier: Any, tenant: Any, op: Any, metric: str = "latency"
+    ) -> bool:
+        if self.metric != metric:
+            return False
         if self.tier is not None and tier != self.tier:
             return False
         if self.tenant is not None and tenant != self.tenant:
@@ -135,11 +154,17 @@ def parse_slo_spec(raw: Optional[str]) -> List[Objective]:
         if not isinstance(e, Mapping):
             raise ValueError(f"SLO_SPEC[{i}] must be an object, got {e!r}")
         unknown = set(e) - {
-            "name", "tier", "tenant", "op",
+            "name", "tier", "tenant", "op", "metric",
             "p50_ms", "p95_ms", "p99_ms", "availability",
         }
         if unknown:
             raise ValueError(f"SLO_SPEC[{i}]: unknown keys {sorted(unknown)}")
+        metric = e.get("metric", "latency")
+        if metric not in METRICS:
+            raise ValueError(
+                f"SLO_SPEC[{i}]: metric must be one of {METRICS}, "
+                f"got {metric!r}"
+            )
         tier = e.get("tier")
         if tier is not None and (
             isinstance(tier, bool) or not isinstance(tier, int)
@@ -188,6 +213,7 @@ def parse_slo_spec(raw: Optional[str]) -> List[Objective]:
             p95_ms=e.get("p95_ms"),
             p99_ms=e.get("p99_ms"),
             availability=avail,
+            metric=str(metric),
         ))
     return out
 
@@ -401,14 +427,18 @@ class SloTracker:
         tenant: Any = None,
         op: Any = None,
         now: Optional[float] = None,
+        metric: str = "latency",
     ) -> None:
         """Record one completed request against every matching objective.
-        O(objectives); a handful of integer bumps per match."""
+        O(objectives); a handful of integer bumps per match. ``metric``
+        routes the observation stream — submit→apply latencies feed the
+        default ``latency`` objectives, serving TTFT samples feed
+        ``metric: "ttft"`` ones (ISSUE 15), never each other."""
         if now is None:
             now = self._clock()
         with self._lock:
             for ow in self._windows:
-                if ow.objective.matches(tier, tenant, op):
+                if ow.objective.matches(tier, tenant, op, metric=metric):
                     ow.observe(latency_s, ok, now)
 
     # ---- judgment ----

@@ -56,6 +56,10 @@ OP_TO_MODULE: Dict[str, str] = {
     # decoder as separate ops, chained across agents via dep-gating.
     "summarize_encode": "summarize_mpmd",
     "summarize_decode": "summarize_mpmd",
+    # Request-serving ops (ISSUE 15): the agent half of POST /v1/infer —
+    # batched interactive classify + the continuous-batching decode engine.
+    "serve_classify": "serve_infer",
+    "serve_summarize": "serve_infer",
     "read_csv_shard": "csv_shard",       # name == registered name (gap 3 fixed)
     "risk_accumulate": "risk_accumulate",
     "trigger_sap": "trigger_sap",        # now a real registered op (gap 4 fixed)

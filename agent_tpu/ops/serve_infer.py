@@ -1,0 +1,463 @@
+"""Request-serving ops: the agent half of the ``POST /v1/infer`` path.
+
+The controller's front door (``controller/serving.py``) coalesces single
+requests into length-bucketed batch jobs; these ops execute them:
+
+- ``serve_classify`` — one batched encoder forward through the existing
+  ``map_classify_tpu`` guts, fanned back out per request. Monolithic: a
+  classify is a single dispatch, there is nothing to batch continuously.
+- ``serve_summarize`` — the decode path, split prefill/decode (ISSUE 15):
+  **prefill** runs as its own batched compiled step (``seq2seq.encode`` —
+  the ``summarize_mpmd`` encoded-handoff shape), then the requests join a
+  process-persistent :class:`~agent_tpu.models.decoding.ContinuousBatcher`
+  whose fixed-capacity running batch decodes ``SERVE_DECODE_SLOTS``
+  requests × ``num_beams`` beam rows per step, finished sequences exiting
+  and queued ones joining *between steps*. Each request carries its own
+  ``max_length`` as the per-slot token limit — short answers free their
+  slot early instead of riding the batch to the longest request's length,
+  which is the whole throughput story vs. the static-batch decode.
+
+Phase contract for the pipelined drain: ``stage``/``finalize`` as usual,
+plus the serving hooks the runner's continuous loop drives —
+``serve_admit`` (prefill + join), ``serve_pump`` (one engine iteration),
+``serve_done``/``serve_collect``. Monolithic callers (serial agent loop,
+tests) get the composed ``run`` which pumps to completion inline.
+
+Scenario ops for the in-house seq2seq family (like ``summarize_mpmd``);
+checkpoint families keep the batch ``map_summarize`` path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from agent_tpu.ops import register_op
+from agent_tpu.utils.errors import bad_input
+
+# Process-wide engine store, keyed by (runtime identity, model/config/shape
+# signature). Device-thread only (engines are created and stepped inside op
+# execute paths — the TPU single-owner rule), so no lock.
+_ENGINES: Dict[Tuple, Any] = {}
+
+
+def reset_engines() -> None:
+    """Drop every cached engine (tests; a fresh runtime invalidates them)."""
+    _ENGINES.clear()
+
+
+def _clamp_ttft(first_wall: Optional[float], arrived: Any) -> Optional[float]:
+    """first-token wall − controller arrival wall, in ms, clamped at 0
+    (the two clocks are different hosts' ``time.time()``; sub-ms skew must
+    not produce negative TTFT)."""
+    if first_wall is None or not isinstance(arrived, (int, float)):
+        return None
+    return round(max(0.0, (first_wall - float(arrived)) * 1e3), 3)
+
+
+def _validate_requests(payload: Dict[str, Any]):
+    reqs = payload.get("requests")
+    if not isinstance(reqs, list) or not reqs:
+        raise ValueError("payload requires a non-empty 'requests' list")
+    for r in reqs:
+        if not (
+            isinstance(r, dict)
+            and isinstance(r.get("req_id"), str) and r["req_id"]
+            and isinstance(r.get("text"), str) and r["text"]
+        ):
+            raise ValueError(
+                "each request needs a string req_id and a non-empty text"
+            )
+    return reqs
+
+
+# ---------------------------------------------------------------------------
+# serve_classify
+# ---------------------------------------------------------------------------
+
+@register_op("serve_classify")
+def run_classify(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    """Batched interactive classify: requests in, per-request top-k out."""
+    t0 = time.perf_counter()
+    if not isinstance(payload, dict):
+        return bad_input("payload must be a dict")
+    try:
+        reqs = _validate_requests(payload)
+    except ValueError as exc:
+        return bad_input(str(exc))
+    topk = payload.get("topk", 1)
+    if isinstance(topk, bool) or not isinstance(topk, int) or topk < 1:
+        return bad_input("topk must be a positive int")
+
+    from agent_tpu.ops import get_op
+
+    sub: Dict[str, Any] = {
+        "texts": [r["text"] for r in reqs],
+        "topk": topk,
+        "allow_fallback": False,
+        "result_format": "columnar",
+    }
+    if isinstance(payload.get("model_config"), dict):
+        sub["model_config"] = payload["model_config"]
+    # The negotiated binary wire ("b1" in ctx.tags) would make classify
+    # emit deflated result columns — this op fans the columns out PER
+    # REQUEST, so it needs them plain; pop the tag for the delegated call
+    # (everything else — timings, usage, FLOPs stamps — keeps flowing).
+    tags = getattr(ctx, "tags", None) if ctx is not None else None
+    wire_fmt = tags.pop("wire", None) if isinstance(tags, dict) else None
+    try:
+        out = get_op("map_classify_tpu")(sub, ctx)
+    finally:
+        if wire_fmt is not None:
+            tags["wire"] = wire_fmt
+    if not (isinstance(out, dict) and out.get("ok") is True):
+        return out  # soft error shape propagates as this op's result
+    now = time.time()
+    results = [
+        {
+            "req_id": r["req_id"],
+            "indices": out["indices"][i],
+            "scores": out["scores"][i],
+            # No decode stream: the first answer byte IS the whole answer.
+            "ttft_ms": _clamp_ttft(now, r.get("arrived_wall")),
+            "tokens": 0,
+        }
+        for i, r in enumerate(reqs)
+    ]
+    return {
+        "ok": True,
+        "op": "serve_classify",
+        "device": out.get("device"),
+        "model": out.get("model"),
+        "n_requests": len(reqs),
+        "results": results,
+        "occupancy": float(len(reqs)),
+        "max_occupancy": len(reqs),
+        "elapsed_ms": (time.perf_counter() - t0) * 1000.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# serve_summarize
+# ---------------------------------------------------------------------------
+
+def _resolve(payload: Dict[str, Any]):
+    from agent_tpu.models import bert
+    from agent_tpu.models.seq2seq import Seq2SeqConfig
+    from agent_tpu.ops._model_common import (
+        config_from_payload,
+        resolve_model_id,
+    )
+
+    model_id = resolve_model_id(payload, "BART_MODEL", "summarize-default")
+    if bert.is_hf_dir(model_id):
+        raise ValueError(
+            "serve_summarize serves the in-house seq2seq family; checkpoint "
+            "directories stay on the batch map_summarize path"
+        )
+    cfg = config_from_payload(payload, Seq2SeqConfig)
+    return model_id, cfg
+
+
+def _runtime(ctx):
+    if ctx is not None and getattr(ctx, "require_runtime", None):
+        return ctx.require_runtime()
+    from agent_tpu.runtime.runtime import get_runtime
+
+    return get_runtime()
+
+
+def _serve_knobs(ctx) -> Tuple[int, int]:
+    """(decode_slots, micro_steps) from the agent config (SERVE_* env)."""
+    cfg = getattr(ctx, "config", None) if ctx is not None else None
+    serve = getattr(cfg, "serve", None) if cfg is not None else None
+    if serve is None:
+        from agent_tpu.config import ServeConfig
+
+        serve = ServeConfig.from_env()
+    return int(serve.decode_slots), int(serve.decode_micro_steps)
+
+
+def stage(payload: Any, ctx: Optional[object] = None):
+    """Host phase: validate the batch, fused byte-tokenize+pad every request
+    to the bucket length the controller coalesced on."""
+    t0 = time.perf_counter()
+    if not isinstance(payload, dict):
+        return "done", bad_input("payload must be a dict")
+    try:
+        reqs = _validate_requests(payload)
+        model_id, cfg = _resolve(payload)
+    except ValueError as exc:
+        return "done", bad_input(str(exc))
+
+    num_beams = payload.get("num_beams", 1)
+    if isinstance(num_beams, bool) or not isinstance(num_beams, int) or \
+            not 1 <= num_beams <= 16:
+        return "done", bad_input("num_beams must be an int in [1, 16]")
+    length_penalty = payload.get("length_penalty", 1.0)
+    if isinstance(length_penalty, bool) or \
+            not isinstance(length_penalty, (int, float)) or \
+            not -4.0 <= float(length_penalty) <= 4.0:
+        return "done", bad_input("length_penalty must be a number in [-4, 4]")
+    early_stopping = payload.get("early_stopping", False)
+    if not isinstance(early_stopping, bool):
+        return "done", bad_input("early_stopping must be a bool")
+    min_length = payload.get("min_length", 0)
+    if isinstance(min_length, bool) or not isinstance(min_length, int) or \
+            min_length < 0:
+        return "done", bad_input("min_length must be a non-negative int")
+    bucket = payload.get("bucket", cfg.max_src_len)
+    if isinstance(bucket, bool) or not isinstance(bucket, int) or bucket < 1:
+        return "done", bad_input("bucket must be a positive int")
+    bucket = min(bucket, cfg.max_src_len)
+
+    from agent_tpu.models.tokenizer import byte_encode_pad
+
+    # One fixed padded length per batch (the controller's length bucket):
+    # the prefill program and the engine's encoder block key on it.
+    ids, lengths = byte_encode_pad(
+        [r["text"] for r in reqs], buckets=(bucket,), max_len_cap=bucket,
+        add_bos=True, add_eos=True,
+    )
+    limits = []
+    for r in reqs:
+        lim = r.get("max_length")
+        if lim is None:
+            lim = cfg.max_tgt_len
+        if isinstance(lim, bool) or not isinstance(lim, int) or lim < 1:
+            return "done", bad_input("max_length must be a positive int")
+        limits.append(min(lim, cfg.max_tgt_len))
+    state = {
+        "t0": t0,
+        "reqs": reqs,
+        "ids": ids.astype(np.int32),
+        "lengths": np.asarray(lengths, dtype=np.int32),
+        "limits": limits,
+        "bucket": int(ids.shape[1]),
+        "model_id": model_id,
+        "cfg": cfg,
+        "num_beams": num_beams,
+        "length_penalty": float(length_penalty),
+        "early_stopping": early_stopping,
+        "min_length": min_length,
+        "t_staged": time.perf_counter(),
+    }
+    return "staged", state
+
+
+def _params_key(model_id: str, cfg) -> str:
+    """EXACTLY ``map_summarize``'s params-store key for the seq2seq family,
+    so colocated serving + batch ops share one HBM weight copy."""
+    from agent_tpu.ops._model_common import cfg_key
+
+    return f"{model_id}#seq2seq#{hash(cfg_key(cfg)) & 0xFFFFFFFF:08x}"
+
+
+def _get_params(runtime, model_id: str, cfg):
+    from agent_tpu.ops._model_common import maybe_quantize_specs
+    from agent_tpu.ops.map_summarize import _build_params
+    from agent_tpu.parallel.shardings import seq2seq_param_specs
+
+    specs = maybe_quantize_specs(seq2seq_param_specs(cfg), "seq2seq", cfg)
+    return runtime.get_params(
+        _params_key(model_id, cfg),
+        lambda: _build_params(model_id, cfg, "seq2seq"),
+        specs=specs,
+    )
+
+
+def _get_engine(runtime, params, state, slots: int, micro_steps: int = 1):
+    from agent_tpu.models import seq2seq
+    from agent_tpu.models.decoding import ContinuousBatcher
+    from agent_tpu.models.tokenizer import BOS_ID, EOS_ID, PAD_ID
+    from agent_tpu.ops._model_common import cfg_key
+
+    cfg = state["cfg"]
+    key = (
+        id(runtime), state["model_id"], cfg_key(cfg), state["bucket"],
+        state["num_beams"], state["min_length"], state["length_penalty"],
+        state["early_stopping"], slots, micro_steps,
+    )
+    engine = _ENGINES.get(key)
+    if engine is None:
+        engine = ContinuousBatcher(
+            seq2seq.make_positional_step(params, cfg),
+            seq2seq.make_cache_factory(cfg),
+            slots=slots,
+            vocab_size=cfg.vocab_size,
+            max_tokens=cfg.max_tgt_len,
+            enc_len=state["bucket"],
+            d_model=cfg.d_model,
+            start_id=BOS_ID, eos_id=EOS_ID, pad_id=PAD_ID,
+            num_beams=state["num_beams"],
+            min_length=state["min_length"],
+            length_penalty=state["length_penalty"],
+            early_stopping=state["early_stopping"],
+            micro_steps=micro_steps,
+        )
+        _ENGINES[key] = engine
+    return engine
+
+
+def serve_admit(state: Dict[str, Any], ctx: Optional[object] = None
+                ) -> Dict[str, Any]:
+    """Device phase, part 1 — prefill as its own batched step, then join
+    the continuous engine (between decode iterations, never inside one).
+    Returns the handle the runner pumps."""
+    import jax
+
+    runtime = _runtime(ctx)
+    cfg, model_id = state["cfg"], state["model_id"]
+    params = _get_params(runtime, model_id, cfg)
+    slots, micro_steps = _serve_knobs(ctx)
+    engine = _get_engine(runtime, params, state, slots, micro_steps)
+    ids, lengths = state["ids"], state["lengths"]
+    B, Ls = ids.shape
+
+    def build(Ls=Ls):
+        import jax.numpy as jnp
+
+        from agent_tpu.models import seq2seq
+
+        def run_enc(p, i, nlen):
+            mask = (jnp.arange(Ls)[None, :] < nlen[:, None]).astype(jnp.int32)
+            enc = seq2seq.encode(p, i.astype(jnp.int32), mask, cfg)
+            # f32 handoff like summarize_mpmd: a bf16→f32 widening is
+            # lossless and the engine re-casts to its compute dtype.
+            return enc.astype(jnp.float32)
+
+        return jax.jit(run_enc)
+
+    from agent_tpu.ops._model_common import cfg_key
+
+    fn = runtime.compiled(
+        ("serve_prefill", model_id, B, Ls, cfg_key(cfg)), build
+    )
+    enc = np.asarray(fn(params, ids, lengths))
+    masks = (
+        np.arange(Ls)[None, :] < state["lengths"][:, None]
+    ).astype(np.int32)
+    t_admit = time.perf_counter()
+    steps0, occ0 = engine.steps_run, engine.occupancy_sum
+    tickets = []
+    for i, r in enumerate(state["reqs"][: len(state["limits"])]):
+        tickets.append(
+            engine.admit(
+                enc[i], masks[i], state["limits"][i],
+                data={"req_id": r["req_id"],
+                      "arrived_wall": r.get("arrived_wall")},
+            )
+        )
+    return {
+        "engine": engine,
+        "tickets": tickets,
+        "state": state,
+        "t_admit": t_admit,
+        "steps0": steps0,
+        "occ0": occ0,
+        "device": runtime.platform,
+    }
+
+
+def serve_pump(handle: Dict[str, Any]) -> int:
+    """One decode iteration of the handle's engine (finished sequences exit,
+    backlog joins). Returns the live occupancy after the step."""
+    engine = handle["engine"]
+    engine.step()
+    return engine.occupancy
+
+
+def serve_done(handle: Dict[str, Any]) -> bool:
+    return all(t.done_wall is not None for t in handle["tickets"])
+
+
+def serve_collect(handle: Dict[str, Any]) -> Dict[str, Any]:
+    """Handle → executed-state (the poster thread's finalize input)."""
+    engine, state = handle["engine"], handle["state"]
+    d_steps = max(1, engine.steps_run - handle["steps0"])
+    d_occ = engine.occupancy_sum - handle["occ0"]
+    return {
+        "state": state,
+        "tickets": handle["tickets"],
+        "device": handle["device"],
+        "occupancy": round(d_occ / d_steps, 3),
+        "max_occupancy": engine.max_occupancy,
+        "t_admit": handle["t_admit"],
+        "t_device": time.perf_counter(),
+    }
+
+
+def execute(state: Dict[str, Any], ctx: Optional[object] = None
+            ) -> Dict[str, Any]:
+    """Monolithic device phase: admit, pump this job's tickets to
+    completion inline (the pipelined runner interleaves instead)."""
+    handle = serve_admit(state, ctx)
+    handle["engine"].run(handle["tickets"])
+    return serve_collect(handle)
+
+
+def finalize(executed: Dict[str, Any], ctx: Optional[object] = None
+             ) -> Dict[str, Any]:
+    """Host phase: detokenize each ticket's emitted tokens, shape the
+    per-request fan-out entries the controller's front door expects."""
+    from agent_tpu.models.tokenizer import ByteTokenizer
+
+    state = executed["state"]
+    tok = ByteTokenizer()
+    results: List[Dict[str, Any]] = []
+    for ticket in executed["tickets"]:
+        row = ticket.tokens if ticket.tokens is not None else np.array([], int)
+        results.append({
+            "req_id": ticket.data["req_id"],
+            "summary": tok.decode([t for t in row if t > 0]),
+            "tokens": int(ticket.length),
+            "steps": int(ticket.steps),
+            "ttft_ms": _clamp_ttft(
+                ticket.first_token_wall, ticket.data.get("arrived_wall")
+            ),
+        })
+    if ctx is not None and hasattr(ctx, "tags"):
+        ctx.tags.setdefault("timings", {}).update(
+            stage_ms=round((state["t_staged"] - state["t0"]) * 1e3, 3),
+            device_ms=round(
+                (executed["t_device"] - executed["t_admit"]) * 1e3, 3
+            ),
+        )
+    from agent_tpu.ops._model_common import stamp_rows
+
+    stamp_rows(ctx, len(results))
+    return {
+        "ok": True,
+        "op": "serve_summarize",
+        "device": executed["device"],
+        "model": state["model_id"],
+        "num_beams": state["num_beams"],
+        "n_requests": len(results),
+        "results": results,
+        "occupancy": executed["occupancy"],
+        "max_occupancy": executed["max_occupancy"],
+        "elapsed_ms": (time.perf_counter() - state["t0"]) * 1000.0,
+    }
+
+
+@register_op("serve_summarize")
+def run_summarize(payload: Any, ctx: Optional[object] = None
+                  ) -> Dict[str, Any]:
+    """Classic monolithic entry: stage → execute → finalize inline."""
+    phase, value = stage(payload, ctx)
+    if phase == "done":
+        return value
+    return finalize(execute(value, ctx), ctx)
+
+
+# Phase hooks for the pipelined drain, plus the serving hooks its
+# continuous loop drives (agent_tpu.agent.pipeline).
+run_summarize.stage = stage
+run_summarize.execute = execute
+run_summarize.finalize = finalize
+run_summarize.serve_admit = serve_admit
+run_summarize.serve_pump = serve_pump
+run_summarize.serve_done = serve_done
+run_summarize.serve_collect = serve_collect

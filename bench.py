@@ -668,7 +668,13 @@ def _bench_summarize(runtime, batch: int = SUMMARIZE_BATCH,
         return batch * max_new * iters / dt, dt * 1000.0
 
     tok_per_sec, _, spread = _median_windows(window, WINDOWS)
+    # Per-chip normalization like the classify flat field (ISSUE 15
+    # satellite): real TPU legs engage the whole mesh; on host backends the
+    # forced virtual devices share one CPU and are not chips.
+    chips = runtime.n_devices if runtime.platform == "tpu" else 1
     leg = {"decode_tok_per_sec": round(tok_per_sec, 1),
+           "tok_per_sec_per_chip": round(tok_per_sec / chips, 1),
+           "n_chips_used": chips,
            "spread_pct": round(spread, 2), "windows": WINDOWS,
            "iters": iters, "num_beams": num_beams}
     if quant:
@@ -1360,6 +1366,308 @@ def _bench_drain_multichip(n_rows: int = MULTICHIP_ROWS,
     return leg
 
 
+# Serving leg (ISSUE 15). Request mix: 90% short answers / 10% full-length
+# — the interactive shape continuous batching exists for (short requests
+# exit the running batch and free their slot; a static batch pays its
+# longest rider for every seat). Recorded in the leg so the speedup is
+# attributable to a stated workload, not a tuned one. MICRO_STEPS fuses
+# decode iterations per dispatch where dispatch overhead would otherwise
+# dominate (CPU smoke, tiny models); membership changes between chunks.
+SERVE_BENCH_REQUESTS = 240
+SERVE_BENCH_SLOTS = 8
+SERVE_BENCH_SHORT_FRAC = 0.9
+SERVE_BENCH_MICRO_STEPS = 4
+SERVE_HTTP_DURATION_SEC = 8.0
+SERVE_HTTP_RATE = 4.0
+
+
+def _bench_serving_beam(runtime):
+    """Continuous-batching beam decode vs the static-batch beam baseline on
+    the SAME seeded request stream (per-request token budgets drawn 90/10
+    short/long): the static path decodes arrival-order batches of
+    ``SERVE_BENCH_SLOTS`` requests, each batch running to its longest
+    rider's budget (what a batch-serving stack without iteration-level
+    membership does — BENCH_r05's beam leg shape); the continuous path runs
+    the engine with per-slot limits, exits freeing slots for the backlog
+    between steps. Per-request outputs equal a solo decode of that
+    request's own budget (regression-tested in tests/test_serving.py);
+    tok/s counts the REQUESTED token budgets both sides, so the speedup is
+    useful-tokens wall-clock, not padding."""
+    import jax
+    import numpy as np
+
+    from agent_tpu.models import seq2seq
+    from agent_tpu.models.decoding import ContinuousBatcher
+    from agent_tpu.models.tokenizer import BOS_ID, EOS_ID, PAD_ID
+
+    smoke = runtime.platform != "tpu"
+    cfg = seq2seq.Seq2SeqConfig() if not smoke else seq2seq.Seq2SeqConfig(
+        d_model=128, n_heads=4, n_enc_layers=2, n_dec_layers=2, d_ff=256,
+        max_src_len=64, max_tgt_len=64, dtype="float32",
+    )
+    n_req = SERVE_BENCH_REQUESTS
+    K, slots = 4, SERVE_BENCH_SLOTS
+    # Dispatch-bound smoke shapes amortize dispatch via fused micro-steps;
+    # real TPU runs pure iteration-level stepping (buffer donation works).
+    micro = SERVE_BENCH_MICRO_STEPS if smoke else 1
+    src_len = 64
+    T = cfg.max_tgt_len
+    short = max(2, T // 32)
+    rng = np.random.default_rng(5)
+    limits = [
+        short if rng.random() < SERVE_BENCH_SHORT_FRAC else T
+        for _ in range(n_req)
+    ]
+    ids = rng.integers(4, cfg.vocab_size, (n_req, src_len)).astype(np.int32)
+    mask = np.ones((n_req, src_len), dtype=np.int32)
+    params = jax.device_put(
+        seq2seq.init_params(cfg, model_id="bench-serving"),
+        runtime.replicated(),
+    )
+
+    # ---- static baseline: arrival-order batches, padded to batch max ----
+    gens: dict = {}
+
+    def gen_for(n, max_new):
+        key = (n, max_new)
+        if key not in gens:
+            gens[key] = jax.jit(
+                lambda p, i, m, mn=max_new: seq2seq.beam_generate(
+                    p, i, m, cfg, mn, num_beams=K,
+                )
+            )
+        return gens[key]
+
+    batches = [
+        (slice(s, min(s + slots, n_req)),
+         max(limits[s: min(s + slots, n_req)]))
+        for s in range(0, n_req, slots)
+    ]
+    for n, mx in {(b.stop - b.start, mx) for b, mx in batches}:
+        np.asarray(gen_for(n, mx)(params, ids[:n], mask[:n])[0])  # warm
+    t0 = time.perf_counter()
+    static_steps = 0
+    for bat, mx in batches:
+        np.asarray(gen_for(bat.stop - bat.start, mx)(
+            params, ids[bat], mask[bat]
+        )[0])
+        static_steps += mx
+    static_wall = time.perf_counter() - t0
+
+    # ---- continuous engine on the identical stream ----
+    enc_fn = jax.jit(
+        lambda p, i, m: seq2seq.encode(p, i, m, cfg).astype(jax.numpy.float32)
+    )
+    enc_all = np.asarray(enc_fn(params, ids, mask))
+    # ONE persistent engine, like the serving agent's: the warm pass pays
+    # trace+compile, the measured pass is the steady-state cost.
+    engine = ContinuousBatcher(
+        seq2seq.make_positional_step(params, cfg),
+        seq2seq.make_cache_factory(cfg),
+        slots=slots, vocab_size=cfg.vocab_size, max_tokens=T,
+        enc_len=src_len, d_model=cfg.d_model,
+        start_id=BOS_ID, eos_id=EOS_ID, pad_id=PAD_ID, num_beams=K,
+        micro_steps=micro,
+    )
+
+    def run_engine():
+        tickets = [
+            engine.admit(enc_all[i], mask[i], limits[i], data=i)
+            for i in range(n_req)
+        ]
+        s0 = engine.steps_run
+        while engine.has_work():
+            engine.step()
+        return tickets, engine.steps_run - s0
+
+    run_engine()  # warm the step/insert/prefill programs
+    t0 = time.perf_counter()
+    tickets, engine_steps = run_engine()
+    cont_wall = time.perf_counter() - t0
+    # Same numerator both sides: the tokens the requests ASKED for (the
+    # static path additionally decoded short rows out to the batch max —
+    # that padding waste is exactly the cost being measured).
+    tokens = sum(t.steps for t in tickets)
+    return {
+        "requests": n_req,
+        "num_beams": K,
+        "slots": slots,
+        "micro_steps": micro,
+        "short_frac": SERVE_BENCH_SHORT_FRAC,
+        "limit_short": short,
+        "limit_long": T,
+        "tokens": tokens,
+        "static_steps": static_steps,
+        "engine_steps": engine_steps,
+        "static_tok_per_sec": round(tokens / static_wall, 1),
+        "continuous_tok_per_sec": round(tokens / cont_wall, 1),
+        "speedup_vs_static": round(static_wall / cont_wall, 3),
+        "mean_occupancy": round(engine.mean_occupancy(), 2),
+    }
+
+
+def _bench_serving(runtime):
+    """``serving`` leg (ISSUE 15): loadgen-driven interactive classify +
+    summarize requests against a REAL ``POST /v1/infer`` HTTP front door
+    *while* a bulk classify drain runs through the same pipelined agent —
+    TTFT p50/p99 and tok/s for the interactive traffic, the /v1/health
+    verdict (per-tier SLOs judging it), plus the continuous-vs-static beam
+    engine comparison above."""
+    import statistics as _stats
+    import tempfile
+    import threading
+
+    import requests
+
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.agent.pipeline import PipelineRunner
+    from agent_tpu.config import AgentConfig, Config, ServeConfig
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+    from agent_tpu.loadgen import ArrivalPattern, LoadGen, TrafficClass
+    from agent_tpu.loadgen import session_submitter
+
+    smoke = runtime.platform != "tpu"
+    s2s_cfg = None if not smoke else {
+        "d_model": 32, "n_heads": 4, "n_enc_layers": 1, "n_dec_layers": 1,
+        "d_ff": 64, "max_src_len": 64, "max_tgt_len": 16, "dtype": "float32",
+    }
+    cls_cfg = None if not smoke else {
+        "d_model": 32, "n_heads": 4, "n_layers": 1, "d_ff": 64,
+        "max_len": 64, "dtype": "float32", "n_classes": 16,
+    }
+    bulk_rows, bulk_shard = (2048, 256) if smoke else (DRAIN_ROWS,
+                                                      DRAIN_SHARD_SIZE)
+    duration = SERVE_HTTP_DURATION_SEC
+    rate = SERVE_HTTP_RATE
+
+    def params_for(op):
+        if op == "summarize":
+            p = {"max_length": 8}
+            if s2s_cfg:
+                p["model_config"] = s2s_cfg
+            return p
+        p = {"topk": 1}
+        if cls_cfg:
+            p["model_config"] = cls_cfg
+        return p
+
+    classes = [
+        TrafficClass(
+            name="infer_classify", op="classify", weight=2.0, route="infer",
+            payload_fn=lambda rng, seq: {
+                "text": f"interactive classify request {seq} "
+                        + "with payload " * (seq % 3 + 1),
+                "params": params_for("classify"),
+            },
+        ),
+        TrafficClass(
+            name="infer_summarize", op="summarize", weight=2.0,
+            route="infer",
+            payload_fn=lambda rng, seq: {
+                "text": f"interactive summarize request {seq} "
+                        + "with payload " * (seq % 3 + 1),
+                "params": {
+                    **params_for("summarize"),
+                    "max_length": 4 + seq % 8,
+                },
+            },
+        ),
+    ]
+    leg: dict = {}
+    controller = Controller(
+        lease_ttl_sec=600.0,
+        serve=ServeConfig(max_wait_ms=20.0, max_batch=8),
+    )
+    server = ControllerServer(controller).start()
+    try:
+        cfg = Config(agent=AgentConfig(
+            controller_url=server.url, agent_name="bench-serving",
+            tasks=("serve_classify", "serve_summarize", "map_classify_tpu"),
+            idle_sleep_sec=0.0,
+        ))
+        agent = Agent(config=cfg, session=requests.Session(),
+                      runtime=runtime)
+        agent._profile = {"tier": "bench"}
+        runner = PipelineRunner(agent, depth=2)
+        rt = threading.Thread(target=runner.run, daemon=True)
+        rt.start()
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "bulk.csv")
+            with open(path, "w") as f:
+                f.write("id,text\n")
+                for i in range(bulk_rows):
+                    f.write(f'{i},"drain record {i} with a payload"\n')
+            bulk_extra = {"text_field": "text", "allow_fallback": False,
+                          "result_format": "columnar"}
+            if cls_cfg:
+                bulk_extra["model_config"] = cls_cfg
+            # Warm the serving + bulk executables outside the window.
+            sess = requests.Session()
+            for op in ("classify", "summarize"):
+                r = sess.post(server.url + "/v1/infer", json={
+                    "op": op, "text": "warm the serving path",
+                    "params": params_for(op),
+                }, timeout=300)
+                assert r.status_code == 200 and \
+                    r.json()["state"] == "done", r.text
+            controller.submit_csv_job(
+                path, total_rows=bulk_shard, shard_size=bulk_shard,
+                map_op="map_classify_tpu", extra_payload=bulk_extra,
+            )
+            while not controller.drained():
+                time.sleep(0.02)
+
+            # The measured window: bulk drain + open-loop interactive load.
+            controller.submit_csv_job(
+                path, total_rows=bulk_rows, shard_size=bulk_shard,
+                map_op="map_classify_tpu", extra_payload=bulk_extra,
+            )
+            gen = LoadGen(classes, ArrivalPattern(rate), seed=7)
+            t0 = time.perf_counter()
+            stats = gen.run(
+                session_submitter(sess, server.url), duration
+            )
+            req_ids = stats.job_ids()
+            snaps = []
+            for rid in req_ids:
+                snap = controller.wait_infer(rid, 300.0)
+                assert snap is not None and snap["state"] == "done", snap
+                snaps.append(snap)
+            window = time.perf_counter() - t0
+            while not controller.drained():
+                time.sleep(0.02)
+            ttfts = sorted(
+                s["ttft_ms"] for s in snaps if s.get("ttft_ms") is not None
+            )
+            tokens = sum(s.get("tokens") or 0 for s in snaps)
+            from agent_tpu.obs.scrape import fetch_health
+
+            health = fetch_health(server.url)
+            leg.update(
+                requests=len(snaps),
+                rejected=stats.total_rejected(),
+                bulk_rows=bulk_rows,
+                window_s=round(window, 2),
+                ttft_p50_ms=round(_stats.median(ttfts), 1) if ttfts else None,
+                ttft_p99_ms=round(
+                    ttfts[max(0, int(len(ttfts) * 0.99) - 1)], 1
+                ) if ttfts else None,
+                tok_per_sec=round(tokens / window, 1) if window else None,
+                health_verdict=(health or {}).get("verdict"),
+            )
+        agent.running = False
+        rt.join(timeout=60)
+    finally:
+        server.stop()
+    leg["beam"] = _bench_serving_beam(runtime)
+    chips = runtime.n_devices if runtime.platform == "tpu" else 1
+    leg["beam_tok_per_sec_per_chip"] = round(
+        leg["beam"]["continuous_tok_per_sec"] / chips, 1
+    )
+    return leg
+
+
 def main() -> int:
     from agent_tpu.runtime.runtime import get_runtime
 
@@ -1461,6 +1769,10 @@ def main() -> int:
         # processes + dp=N mesh agent vs the 1-chip reference, scaling
         # efficiency asserted when the host has the cores.
         ("drain_multichip", _bench_drain_multichip),
+        # Online serving (ISSUE 15): loadgen-driven POST /v1/infer traffic
+        # concurrent with a bulk drain (TTFT p50/p99, tok/s, SLO verdict) +
+        # the continuous-vs-static beam engine comparison.
+        ("serving", lambda: _bench_serving(runtime)),
     ):
         try:
             legs[name] = fn()
@@ -1494,6 +1806,11 @@ def main() -> int:
                     "multichip_agents": MULTICHIP_AGENTS,
                     "multichip_rows": MULTICHIP_ROWS,
                     "multichip_shard_size": MULTICHIP_SHARD,
+                    "serve_bench_requests": SERVE_BENCH_REQUESTS,
+                    "serve_bench_slots": SERVE_BENCH_SLOTS,
+                    "serve_bench_short_frac": SERVE_BENCH_SHORT_FRAC,
+                    "serve_http_duration_sec": SERVE_HTTP_DURATION_SEC,
+                    "serve_http_rate": SERVE_HTTP_RATE,
                 },
                 "metric": "map_classify_tpu rows/sec/chip",
                 "value": round(rows_per_sec_per_chip, 1),
@@ -1579,6 +1896,19 @@ def main() -> int:
                 "usage_device_seconds": legs.get("drain_mixed", {})
                 .get("usage_device_seconds"),
                 "usage_rows": legs.get("drain_mixed", {}).get("usage_rows"),
+                # Serving flat fields (ISSUE 15): interactive TTFT/tok-per-
+                # sec measured concurrently with a bulk drain, plus the
+                # continuous-batching beam engine vs the static-batch
+                # baseline on the same request stream.
+                "serving_ttft_p50_ms": legs["serving"].get("ttft_p50_ms"),
+                "serving_ttft_p99_ms": legs["serving"].get("ttft_p99_ms"),
+                "serving_tok_per_sec": legs["serving"].get("tok_per_sec"),
+                "serving_beam_tok_per_sec": (
+                    legs["serving"].get("beam") or {}
+                ).get("continuous_tok_per_sec"),
+                "serving_beam_speedup_vs_static": (
+                    legs["serving"].get("beam") or {}
+                ).get("speedup_vs_static"),
                 # Control-plane flat fields (ISSUE 14): the controller
                 # ceiling as tracked numbers — submit/lease throughput and
                 # the snapshot-compaction replay speedup.

@@ -1,0 +1,286 @@
+#!/usr/bin/env python3
+"""CI smoke for the online-serving front door (ISSUE 15).
+
+Drives interactive ``POST /v1/infer`` requests against a REAL HTTP
+controller + a real pipelined agent WHILE a bulk classify drain runs
+through the same agent, and asserts the serving acceptance bar:
+
+1. every interactive request completes (classify + summarize, greedy and
+   beam, mixed per-request ``max_length`` budgets);
+2. TTFT stays under a generous CI bound (the compile cost is paid by a
+   warmup request, so the bound judges queueing+decode, not tracing);
+3. iteration-level batching actually batched: some serving batch reports
+   running-batch occupancy > 1 (several requests seated at once);
+4. the bulk drain's results are BIT-IDENTICAL to a serving-off reference
+   drain of the same job — interactive traffic must not perturb batch
+   results;
+5. the SLO engine judged the serving stream: the default
+   ``interactive_ttft`` objective (metric: ttft) saw every completed
+   request.
+
+CPU-shape smoke (tiny models, JAX_PLATFORMS=cpu): wall target well under a
+minute of drain work. Exit 0 = all bars met.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+TINY_S2S = {
+    "d_model": 32, "n_heads": 4, "n_enc_layers": 1, "n_dec_layers": 1,
+    "d_ff": 64, "max_src_len": 64, "max_tgt_len": 16, "dtype": "float32",
+}
+TINY_CLS = {
+    "d_model": 32, "n_heads": 4, "n_layers": 1, "d_ff": 64,
+    "max_len": 64, "dtype": "float32", "n_classes": 16,
+}
+BULK_ROWS = 1024
+BULK_SHARD = 128
+N_INFER = 24
+TTFT_BOUND_MS = 30_000.0   # generous: 1-core CI containers stall freely
+
+
+def write_csv(path: str, rows: int) -> None:
+    with open(path, "w") as f:
+        f.write("id,text\n")
+        for i in range(rows):
+            f.write(f'{i},"serving smoke record {i} with a payload"\n')
+
+
+def bulk_results(controller, shard_ids):
+    out = {}
+    for jid in shard_ids:
+        snap = controller.job_snapshot(jid)
+        assert snap["state"] == "succeeded", (jid, snap["state"],
+                                              snap["error"])
+        r = snap["result"]
+        assert isinstance(r, dict) and r.get("ok") is True, (jid, r)
+        out[controller.job(jid).payload["start_row"]] = (
+            r["indices"], r["scores"],
+        )
+    return out
+
+
+def drain_reference(csv_path):
+    """Serving-off reference drain: same bulk job, no interactive load."""
+    import requests
+
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.agent.pipeline import PipelineRunner
+    from agent_tpu.config import AgentConfig, Config, ServeConfig
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+
+    controller = Controller(
+        lease_ttl_sec=600.0, serve=ServeConfig(enabled=False),
+    )
+    server = ControllerServer(controller).start()
+    try:
+        cfg = Config(agent=AgentConfig(
+            controller_url=server.url, agent_name="smoke-ref",
+            tasks=("map_classify_tpu",), idle_sleep_sec=0.0,
+        ))
+        agent = Agent(config=cfg, session=requests.Session())
+        agent._profile = {"tier": "smoke"}
+        runner = PipelineRunner(agent, depth=2)
+        t = threading.Thread(target=runner.run, daemon=True)
+        t.start()
+        shard_ids, _ = controller.submit_csv_job(
+            csv_path, total_rows=BULK_ROWS, shard_size=BULK_SHARD,
+            map_op="map_classify_tpu",
+            extra_payload={"text_field": "text", "allow_fallback": False,
+                           "result_format": "columnar",
+                           "model_config": TINY_CLS},
+        )
+        deadline = time.monotonic() + 600
+        while not controller.drained():
+            assert time.monotonic() < deadline, controller.counts()
+            time.sleep(0.02)
+        agent.running = False
+        t.join(timeout=60)
+        return bulk_results(controller, shard_ids)
+    finally:
+        server.stop()
+
+
+def main() -> int:
+    import requests
+
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.agent.pipeline import PipelineRunner
+    from agent_tpu.config import AgentConfig, Config, ServeConfig
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+    from agent_tpu.loadgen import (
+        ArrivalPattern,
+        LoadGen,
+        TrafficClass,
+        session_submitter,
+    )
+
+    t_start = time.monotonic()
+    with tempfile.TemporaryDirectory() as td:
+        csv_path = os.path.join(td, "bulk.csv")
+        write_csv(csv_path, BULK_ROWS)
+        print("[serving-smoke] serving-off reference drain ...", flush=True)
+        reference = drain_reference(csv_path)
+
+        controller = Controller(
+            lease_ttl_sec=600.0,
+            serve=ServeConfig(max_wait_ms=15.0, max_batch=6),
+        )
+        server = ControllerServer(controller).start()
+        try:
+            cfg = Config(agent=AgentConfig(
+                controller_url=server.url, agent_name="smoke-serving",
+                tasks=("serve_classify", "serve_summarize",
+                       "map_classify_tpu"),
+                idle_sleep_sec=0.0,
+            ))
+            agent = Agent(config=cfg, session=requests.Session())
+            agent._profile = {"tier": "smoke"}
+            runner = PipelineRunner(agent, depth=2)
+            t = threading.Thread(target=runner.run, daemon=True)
+            t.start()
+
+            # Warm the serving + bulk executables (compile cost must not
+            # count against the TTFT bound — production pays it at boot).
+            sess = requests.Session()
+            for op, params in (
+                ("classify", {"model_config": TINY_CLS, "topk": 2}),
+                ("summarize", {"model_config": TINY_S2S, "max_length": 4}),
+                ("summarize", {"model_config": TINY_S2S, "max_length": 4,
+                               "num_beams": 2}),
+            ):
+                r = sess.post(server.url + "/v1/infer", json={
+                    "op": op, "text": "warm the serving path", "params": params,
+                }, timeout=600)
+                assert r.status_code == 200, r.text
+                assert r.json()["state"] == "done", r.json()
+
+            # Interactive load (one shared traffic driver with elastic_soak:
+            # loadgen's infer route) over a running bulk drain.
+            print("[serving-smoke] bulk drain + interactive load ...",
+                  flush=True)
+            shard_ids, _ = controller.submit_csv_job(
+                csv_path, total_rows=BULK_ROWS, shard_size=BULK_SHARD,
+                map_op="map_classify_tpu",
+                extra_payload={"text_field": "text", "allow_fallback": False,
+                               "result_format": "columnar",
+                               "model_config": TINY_CLS},
+            )
+            classes = [
+                TrafficClass(
+                    name="classify", op="classify", weight=1.0,
+                    route="infer",
+                    payload_fn=lambda rng, seq: {
+                        "text": f"interactive classify {seq}",
+                        "params": {"model_config": TINY_CLS, "topk": 2},
+                    },
+                ),
+                TrafficClass(
+                    name="summarize", op="summarize", weight=2.0,
+                    route="infer",
+                    payload_fn=lambda rng, seq: {
+                        "text": f"interactive summarize {seq} "
+                                + "payload " * (seq % 3 + 1),
+                        "params": {
+                            "model_config": TINY_S2S,
+                            "max_length": 3 + seq % 6,
+                            **({"num_beams": 2} if seq % 3 == 0 else {}),
+                        },
+                    },
+                ),
+            ]
+            gen = LoadGen(classes, ArrivalPattern(6.0), seed=7)
+            stats = gen.run(session_submitter(sess, server.url),
+                            max(4.0, N_INFER / 6.0))
+            req_ids = stats.job_ids()
+            assert len(req_ids) >= N_INFER // 2, (
+                f"loadgen submitted only {len(req_ids)} requests"
+            )
+
+            # A concurrent volley on top of the open-loop trickle: 8
+            # same-bucket summarize requests posted together, so the
+            # coalescer and the decode engine demonstrably share the batch
+            # (bar 3 needs overlap, which a trickle of fast tiny decodes
+            # rarely produces by luck).
+            volley_ids = []
+            for i in range(8):
+                r = sess.post(server.url + "/v1/infer", json={
+                    "op": "summarize", "text": f"volley request {i}",
+                    "wait": False,
+                    "params": {"model_config": TINY_S2S,
+                               "max_length": 4 + i},
+                }, timeout=30)
+                assert r.status_code == 200, r.text
+                volley_ids.append(r.json()["req_id"])
+            req_ids.extend(volley_ids)
+
+            # Bar 1+2: every request completes, TTFT under the CI bound.
+            snaps = []
+            for rid in req_ids:
+                snap = controller.wait_infer(rid, 300.0)
+                assert snap is not None and snap["state"] == "done", snap
+                snaps.append(snap)
+            ttfts = [s["ttft_ms"] for s in snaps
+                     if s.get("ttft_ms") is not None]
+            assert ttfts and max(ttfts) < TTFT_BOUND_MS, (
+                f"TTFT bound breached: max {max(ttfts)}ms"
+            )
+
+            deadline = time.monotonic() + 600
+            while not controller.drained():
+                assert time.monotonic() < deadline, controller.counts()
+                time.sleep(0.02)
+
+            # Bar 3: batching actually batched — some serving batch held
+            # more than one request in the running batch / forward.
+            max_occ = 0
+            for jid in controller.results():
+                if not jid.startswith("serve-"):
+                    continue
+                r = controller.job(jid).result
+                if isinstance(r, dict):
+                    max_occ = max(max_occ, int(r.get("max_occupancy") or 0))
+            assert max_occ > 1, (
+                f"no serving batch ever held >1 request (max {max_occ})"
+            )
+
+            # Bar 4: bulk results bit-identical to the serving-off drain.
+            got = bulk_results(controller, shard_ids)
+            assert got == reference, (
+                "bulk drain results diverged with serving traffic on"
+            )
+
+            # Bar 5: the interactive_ttft objective saw the stream.
+            results = {r["objective"]: r for r in controller.slo.evaluate()}
+            seen = results["interactive_ttft"]["windows"]["long"]["requests"]
+            assert seen >= len(snaps), (
+                f"TTFT objective saw {seen} < {len(snaps)} requests"
+            )
+
+            agent.running = False
+            t.join(timeout=60)
+        finally:
+            server.stop()
+    print(
+        f"[serving-smoke] OK: {len(snaps)} interactive requests "
+        f"(ttft p50 {sorted(ttfts)[len(ttfts) // 2]:.0f}ms, "
+        f"max {max(ttfts):.0f}ms), max occupancy {max_occ}, "
+        f"bulk bit-identical over {len(reference)} shards, "
+        f"wall {time.monotonic() - t_start:.1f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,6 +91,68 @@ class Colors:
         return self.paint(state.upper(), FG.get(state, ""), BOLD)
 
 
+def _expo_quantile(samples, family: str, q: float):
+    """q-quantile (seconds) from a family's cumulative ``_bucket`` samples
+    in a parsed exposition (label sets merged — swarmtop shows the fleet
+    line); None when the family is empty."""
+    acc = {}
+    for labels, v in samples.get(family + "_bucket", []):
+        le = labels.get("le")
+        if le is None:
+            continue
+        edge = float("inf") if le in ("+Inf", "inf") else float(le)
+        acc[edge] = acc.get(edge, 0.0) + v
+    if not acc:
+        return None
+    edges = sorted(acc)
+    cum = [acc[e] for e in edges]
+    total = cum[-1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_edge, prev_cum = 0.0, 0.0
+    for edge, c in zip(edges, cum):
+        if c >= target:
+            if edge == float("inf"):
+                finite = [e for e in edges if e != float("inf")]
+                return finite[-1] if finite else None
+            width = c - prev_cum
+            frac = (target - prev_cum) / width if width > 0 else 1.0
+            return prev_edge + (edge - prev_edge) * frac
+        prev_edge, prev_cum = edge, c
+    return edges[-2] if len(edges) > 1 else None
+
+
+def serving_summary(metrics_text, status):
+    """The serving row's feed (ISSUE 15): request-state counts off the
+    /v1/status serving block + TTFT p99 and running-batch occupancy off the
+    exposition. None when serving is disabled."""
+    serving = (status or {}).get("serving") or {}
+    if not serving.get("enabled"):
+        return None
+    out = {
+        "requests": serving.get("requests") or {},
+        "bucketed": serving.get("bucketed", 0),
+        "in_flight": serving.get("jobs_in_flight", 0),
+        "rejected": serving.get("rejected", 0),
+        "ttft_p99_ms": None,
+        "occupancy": None,
+    }
+    if metrics_text:
+        try:
+            samples = parse_exposition(metrics_text)
+        except ValueError:
+            samples = {}
+        p99 = _expo_quantile(samples, "serve_ttft_seconds", 0.99)
+        out["ttft_p99_ms"] = p99 * 1e3 if p99 is not None else None
+        occ = [
+            v for labels, v in samples.get("serve_batch_occupancy", [])
+            if "agent" not in labels
+        ]
+        out["occupancy"] = max(occ) if occ else None
+    return out
+
+
 def tasks_total(metrics_text) -> float:
     """Fleet-wide completed tasks off the exposition (unlabeled merge only —
     ``agent``-labeled duplicates would double-count). The scrape-delta
@@ -155,6 +217,11 @@ def collect_trends(base: str):
             base, "controller_queue_depth", state="leasable"
         ),
         "duty_cycle": fetch_series(base, "device_duty_cycle"),
+        # Serving (ISSUE 15): emitted tokens/sec off the controller's
+        # completion fan-out counter.
+        "serve_tok_per_sec": fetch_series(
+            base, "serve_tokens_total", rate=True
+        ),
     }
 
 
@@ -162,7 +229,8 @@ def last_value(points):
     return points[-1][1] if points else None
 
 
-def render(health, status, rate, colors: Colors, trends=None) -> str:
+def render(health, status, rate, colors: Colors, trends=None,
+           serving=None) -> str:
     lines = []
     verdict = health.get("verdict", "?")
     now = time.strftime("%H:%M:%S")
@@ -226,6 +294,27 @@ def render(health, status, rate, colors: Colors, trends=None) -> str:
                 f"  {label:<9}{spark(vals)}  "
                 f"{fmt_num(vals[-1], digits)}{unit}"
             )
+        lines.append("")
+
+    if serving is not None:
+        # Serving row (ISSUE 15): the /v1/infer front door at a glance —
+        # request states, TTFT p99, tok/s, running-batch occupancy.
+        reqs = serving.get("requests") or {}
+        req_s = " ".join(
+            f"{k}={v}" for k, v in sorted(reqs.items())
+        ) or "-"
+        tok_rate = last_value((trends or {}).get("serve_tok_per_sec"))
+        lines.append(
+            f"{colors.paint('Serving', BOLD)}"
+            f"  ttft p99: {fmt_num(serving.get('ttft_p99_ms'), 1)}ms"
+            f"  tok/s: {fmt_num(tok_rate, 1)}"
+            f"  occupancy: {bar((serving.get('occupancy') or 0) / 16.0, 8)}"
+            f" {fmt_num(serving.get('occupancy'), 0)}"
+            f"  waiting: {serving.get('bucketed', 0)}"
+            f"  batches in flight: {serving.get('in_flight', 0)}"
+            f"  429s: {serving.get('rejected', 0)}"
+        )
+        lines.append(colors.paint(f"  requests: {req_s}", DIM))
         lines.append("")
 
     q = health.get("queue", {})
@@ -324,6 +413,8 @@ def main() -> int:
             continue
         status = fetch_json(base + "/v1/status")
         trends = collect_trends(base)
+        metrics_text = fetch_text(base + "/v1/metrics")
+        serving = serving_summary(metrics_text, status)
         if args.json:
             # One-shot scripting mode (ISSUE 9 satellite): everything the
             # dashboard renders, as one JSON doc on stdout.
@@ -334,9 +425,13 @@ def main() -> int:
                 "status": status,
                 "usage": fetch_json(base + "/v1/usage"),
                 "trends": trends,
+                "serving": serving,
                 "rates": {
                     "tasks_per_sec": last_value(trends["tasks_per_sec"]),
                     "rows_per_sec": last_value(trends["rows_per_sec"]),
+                    "serve_tok_per_sec": last_value(
+                        trends["serve_tok_per_sec"]
+                    ),
                 },
             }
             json.dump(doc, sys.stdout, sort_keys=True)
@@ -346,12 +441,13 @@ def main() -> int:
         # fallback against pre-ring controllers.
         rate = last_value(trends.get("tasks_per_sec"))
         if rate is None:
-            total = tasks_total(fetch_text(base + "/v1/metrics"))
+            total = tasks_total(metrics_text)
             now = time.monotonic()
             if prev_tasks is not None and now > prev_t:
                 rate = max(0.0, (total - prev_tasks) / (now - prev_t))
             prev_tasks, prev_t = total, now
-        frame = render(health, status, rate, colors, trends=trends)
+        frame = render(health, status, rate, colors, trends=trends,
+                       serving=serving)
         if args.once:
             sys.stdout.write(frame)
             return 0

@@ -360,8 +360,11 @@ def test_status_endpoint_schema():
 
     assert set(body) == {
         "counts", "counts_by_op", "queue_depth", "drained", "stale_results",
-        "agents", "summary", "journal", "last_metrics",
+        "agents", "summary", "journal", "serving", "last_metrics",
     }
+    # ISSUE 15: the serving front-door block (request states, buckets,
+    # in-flight batch jobs) — enabled by default.
+    assert body["serving"]["enabled"] is True
     # ISSUE 14 satellite: the journal durability block — replay damage
     # (ISSUE 10) plus segment/snapshot/replay-cost numbers, one schema
     # whether or not a journal is configured (enabled=False here).

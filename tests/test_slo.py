@@ -44,13 +44,33 @@ class FakeClock:
 
 class TestSpecParsing:
     def test_default_spec_is_the_interactive_tier(self):
-        (obj,) = parse_slo_spec("")
+        obj, ttft = parse_slo_spec("")
         assert obj.name == "interactive"
         assert obj.tier == 8
         assert obj.p99_ms == 1000
         assert obj.availability == 0.999
-        assert parse_slo_spec(None)[0] == obj
-        assert parse_slo_spec(DEFAULT_SLO_SPEC)[0] == obj
+        assert obj.metric == "latency"
+        # ISSUE 15: the default gains a serving TTFT objective — fed only
+        # by the /v1/infer completion fan-out, so it idles on batch-only
+        # deployments instead of judging job latencies.
+        assert ttft.name == "interactive_ttft"
+        assert ttft.metric == "ttft"
+        assert ttft.tier == 8
+        assert ttft.p99_ms == 2500
+        assert parse_slo_spec(None) == [obj, ttft]
+        assert parse_slo_spec(DEFAULT_SLO_SPEC) == [obj, ttft]
+
+    def test_metric_routing(self):
+        objs = parse_slo_spec(
+            '[{"name": "lat", "tier": 8, "p99_ms": 100},'
+            ' {"name": "ttft", "tier": 8, "metric": "ttft", "p99_ms": 100}]'
+        )
+        assert objs[0].matches(8, "t", "op")
+        assert not objs[0].matches(8, "t", "op", metric="ttft")
+        assert objs[1].matches(8, "t", "op", metric="ttft")
+        assert not objs[1].matches(8, "t", "op")
+        with pytest.raises(ValueError):
+            parse_slo_spec('[{"metric": "bogus", "p99_ms": 1}]')
 
     def test_explicit_spec_round_trips(self):
         objs = parse_slo_spec(
