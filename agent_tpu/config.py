@@ -640,6 +640,27 @@ class ServeConfig:
     decode_micro_steps: int = 1            # SERVE_MICRO_STEPS
     # HTTP long-poll cap for blocking POST /v1/infer / ?wait_ms GETs.
     wait_timeout_sec: float = 60.0         # SERVE_WAIT_TIMEOUT_SEC
+    # ---- decode-path raw speed (ISSUE 16) ----
+    # KV layout of the continuous decode engine: "paged" allocates
+    # fixed-size KV blocks from a shared pool per layer (block table per
+    # slot row), so resident HBM scales with live tokens instead of
+    # slots × max_tgt_len; "dense" keeps the per-slot full-length
+    # reservation (the bit-identical equivalence reference).
+    kv_layout: str = "paged"               # SERVE_KV_LAYOUT
+    kv_block_size: int = 16                # KV_BLOCK_SIZE (tokens per block)
+    # Pool size in blocks per decoder layer; 0 = auto (dense parity:
+    # rows × blocks-per-row + trash — never stalls admission). Shrink to
+    # trade admission headroom for HBM.
+    kv_pool_blocks: int = 0                # KV_POOL_BLOCKS
+    # Content-hashed prefix cache: repeated prompts skip prefill entirely.
+    prefix_cache_enabled: bool = True      # PREFIX_CACHE_ENABLED
+    prefix_cache_entries: int = 512        # PREFIX_CACHE_ENTRIES
+    prefix_cache_mb: float = 256.0         # PREFIX_CACHE_MB
+    # Disaggregated serving pools: serve_summarize batches split into a
+    # serve_prefill job (encode, b1 binary KV/encoded handoff) dep-gated
+    # into a serve_decode job — prefill-heavy work steers away from decode
+    # agents so bulk prefills can't stall the running batch.
+    disaggregated: bool = False            # SERVE_DISAGG
 
     @staticmethod
     def from_env() -> "ServeConfig":
@@ -664,6 +685,19 @@ class ServeConfig:
             wait_timeout_sec=max(
                 0.1, env_float("SERVE_WAIT_TIMEOUT_SEC", 60.0)
             ),
+            kv_layout=(
+                "dense"
+                if env_str("SERVE_KV_LAYOUT", "paged").strip().lower()
+                == "dense" else "paged"
+            ),
+            kv_block_size=max(1, env_int("KV_BLOCK_SIZE", 16)),
+            kv_pool_blocks=max(0, env_int("KV_POOL_BLOCKS", 0)),
+            prefix_cache_enabled=env_bool("PREFIX_CACHE_ENABLED", True),
+            prefix_cache_entries=max(
+                0, env_int("PREFIX_CACHE_ENTRIES", 512)
+            ),
+            prefix_cache_mb=max(0.0, env_float("PREFIX_CACHE_MB", 256.0)),
+            disaggregated=env_bool("SERVE_DISAGG", False),
         )
 
 

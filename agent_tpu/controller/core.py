@@ -438,6 +438,21 @@ class Controller:
             "controller_serve_batch_occupancy",
             "Continuous-batching running-batch occupancy (mean requests "
             "seated per decode step, as reported by the last serving batch)")
+        # Prefix-cache and paged-KV telemetry (ISSUE 16): serve results
+        # carry per-batch deltas; the controller accumulates them so the
+        # fleet-wide hit rate is one exposition read (swarmtop's column).
+        self._m_serve_prefix = m.counter(
+            "serve_prefix_cache_events_total",
+            "Prefix-cache events reported by serving batches "
+            "(hits = prefill rows served from cache, misses = rows that "
+            "ran the encoder, evictions = LRU discards)", ("event",))
+        self._m_serve_kv_total = m.gauge(
+            "serve_kv_blocks_total",
+            "Paged KV pool size in blocks, as reported by the last "
+            "serving batch's engine (0 = dense layout)")
+        self._m_serve_kv_free = m.gauge(
+            "serve_kv_blocks_free",
+            "Free paged KV blocks after the last serving batch drained")
         if self.serve_config.enabled:
             self.serve_door = ServeFrontDoor(
                 self.serve_config, clock=self._clock
@@ -2138,15 +2153,50 @@ class Controller:
         if door is None:
             return
         for batch in batches:
+            op = SERVE_OPS[batch.key.op]
+            # Disaggregated pools (ISSUE 16): the decode path splits into a
+            # serve_prefill job and a dep-gated serve_decode job, so the two
+            # phases can land on SEPARATE fleets (capability routing + the
+            # fair scheduler's steer). The prefill result's encoded rows
+            # ride the ordinary results wire into the decode job's
+            # ``partials`` — the controller's dep-gating queue IS the
+            # KV-handoff transport, no new endpoints.
+            disagg = (
+                self.serve_config.disaggregated and op == "serve_summarize"
+            )
             job_id = f"serve-{uuid.uuid4().hex[:12]}"
             try:
-                self.submit(
-                    SERVE_OPS[batch.key.op],
-                    batch.job_payload(),
-                    job_id=job_id,
-                    priority=batch.key.priority,
-                    tenant=batch.key.tenant,
-                )
+                if disagg:
+                    pf_id = f"serve-pf-{uuid.uuid4().hex[:12]}"
+                    self.submit(
+                        "serve_prefill",
+                        batch.job_payload(),
+                        job_id=pf_id,
+                        priority=batch.key.priority,
+                        tenant=batch.key.tenant,
+                    )
+                    # If THIS submit 429s the prefill job above is already
+                    # queued and runs as an orphan — its result simply never
+                    # fans out. Acceptable: admission refusal here means the
+                    # system is saturated and the riders fail visibly below.
+                    payload = batch.job_payload()
+                    payload["__collect_partials__"] = True
+                    self.submit(
+                        "serve_decode",
+                        payload,
+                        job_id=job_id,
+                        after=[pf_id],
+                        priority=batch.key.priority,
+                        tenant=batch.key.tenant,
+                    )
+                else:
+                    self.submit(
+                        op,
+                        batch.job_payload(),
+                        job_id=job_id,
+                        priority=batch.key.priority,
+                        tenant=batch.key.tenant,
+                    )
             except AdmissionError as exc:
                 completed = door.fail_batch(batch, {
                     "type": "AdmissionError",
@@ -2190,7 +2240,55 @@ class Controller:
                         "message": "serve batch job vanished",
                     }
                 elif job.state not in TERMINAL_STATES:
-                    continue
+                    # Disaggregated-chain cascade (ISSUE 16): dep gating
+                    # only ever RELEASES on success, so a serve_decode job
+                    # whose prefill dependency died would sit queued
+                    # forever with its riders' HTTP waits open. Fail it
+                    # now, the deadline-death way.
+                    dead_dep = next(
+                        (
+                            d for d in job.after
+                            if d in self._jobs
+                            and self._jobs[d].state in (FAILED, DEAD)
+                        ),
+                        None,
+                    ) if job.state == PENDING and job.after else None
+                    if dead_dep is None:
+                        continue
+                    now = self._clock()
+                    self._sched.discard(job_id)
+                    self._delayed.discard(job_id)
+                    job.error = {
+                        "type": "DependencyFailed",
+                        "message": (
+                            f"serve prefill dependency {dead_dep} failed"
+                        ),
+                        "trace": "",
+                    }
+                    job.state = DEAD
+                    self.traces.finish(
+                        job.job_id, job.root_span_id, now,
+                        attributes={
+                            "outcome": DEAD, "reason": "DependencyFailed",
+                        },
+                    )
+                    self._slo_observe_locked(job, now)
+                    self._m_dead.inc(op=job.op)
+                    self.recorder.record(
+                        "dead", job_id=job_id, op=job.op,
+                        reason="dependency", attempts=job.attempts,
+                    )
+                    # Journaled as a result record so replay keeps it dead.
+                    self._journal({
+                        "ev": "result",
+                        "job_id": job_id,
+                        "state": DEAD,
+                        "epoch": job.epoch,
+                        "attempts": job.attempts,
+                        "result": None,
+                        "error": job.error,
+                    })
+                    ok, result, error = False, None, job.error
                 else:
                     ok = job.state == SUCCEEDED
                     result, error = job.result, job.error
@@ -2202,6 +2300,21 @@ class Controller:
                     occ = result.get("occupancy")
                     if isinstance(occ, (int, float)):
                         self._m_serve_occupancy.set(float(occ))
+                    # Prefix-cache / paged-KV telemetry (ISSUE 16): the
+                    # result carries per-batch deltas (disagg decode jobs
+                    # forward the prefill agent's counters).
+                    pc = result.get("prefix_cache")
+                    if isinstance(pc, dict):
+                        for event in ("hits", "misses", "evictions"):
+                            n = pc.get(event)
+                            if isinstance(n, (int, float)) and n > 0:
+                                self._m_serve_prefix.inc(int(n), event=event)
+                    kv_total = result.get("kv_blocks_total")
+                    if isinstance(kv_total, (int, float)) and kv_total > 0:
+                        self._m_serve_kv_total.set(float(kv_total))
+                        kv_free = result.get("kv_blocks_free")
+                        if isinstance(kv_free, (int, float)):
+                            self._m_serve_kv_free.set(float(kv_free))
                 self._note_serve_completions(completed)
 
     def _note_serve_completions(self, completed: List[Any]) -> None:

@@ -33,6 +33,15 @@ PositionalStepFn = Callable[
 ]
 
 
+class KVPoolExhausted(Exception):
+    """A request's worst-case KV reservation exceeds the whole pool: it can
+    NEVER be seated, no matter how long it waits — the serving layer's 429.
+    (A request that merely has to wait for blocks stays in the backlog; the
+    engine reserves a request's full ``ceil(limit / block_size)`` blocks per
+    beam row at seat time, so a seated request can never run out of blocks
+    mid-decode and is never forced to emit a wrong token.)"""
+
+
 
 def _ban_eos_before(scores, step, min_length: int, eos_id: int):
     """HF ``MinLengthLogitsProcessor``: EOS masked to ``NEG_INF`` while the
@@ -508,13 +517,42 @@ class ContinuousBatcher:
         # dynamic part, so per-iteration buffer traffic on backends without
         # donation (CPU) excludes the encoder block and per-slot limits —
         # they change only at joins, through the insert program.
+        caches = cache_factory(R)
+        # Paged KV (ISSUE 16), detected structurally from the factory's
+        # pytree (``make_paged_cache_factory``): layer caches are shared
+        # block pools addressed through a per-row block table. The device
+        # side is pure dataflow; allocation lives HERE, on the host — a
+        # numpy table mirror plus a free list, pushed to the device (one
+        # tiny [R, MAXB] int32 upload) whenever seats/releases change it.
+        self.paged = isinstance(caches, dict) and "table" in caches
+        if self.paged:
+            table = caches["table"]
+            if table.shape[0] != R:
+                raise ValueError(
+                    f"paged cache table has {table.shape[0]} rows, engine "
+                    f"needs slots*num_beams={R}"
+                )
+            self.kv_block_size = int(caches["layers"][0]["k"].shape[2])
+            self.kv_max_blocks = int(table.shape[1])
+            self.kv_pool_blocks = int(caches["layers"][0]["k"].shape[0])
+            self._table_np = np.zeros(
+                (R, self.kv_max_blocks), dtype=np.int32
+            )
+            # Block 0 is the trash block: released/unallocated table entries
+            # point there so frozen rows' steady rewrites at their final
+            # position can never corrupt a reallocated block.
+            self._free_blocks: List[int] = list(
+                range(1, self.kv_pool_blocks)
+            )
+            self._slot_blocks: Dict[int, List[int]] = {}
+            self._table_dirty = False
         dyn: Dict[str, Any] = {
             "tok": jnp.full((R,), self.start_id, dtype=jnp.int32),
             "pos": jnp.zeros((S,), dtype=jnp.int32),
             # Empty slots are frozen rows (`row_done`): they ride every step
             # as pads + identity reorders and reset on insertion.
             "row_done": jnp.ones((S,), dtype=jnp.bool_),
-            "caches": cache_factory(R),
+            "caches": caches,
         }
         if self.beam:
             dyn["scores"] = jnp.tile(
@@ -687,15 +725,43 @@ class ContinuousBatcher:
         def reorder_all(cs):
             return jax.tree_util.tree_map(reorder, cs)
 
+        def reorder_paged(cs):
+            # Paged beam reorder: blocks are row-exclusive (two sibling
+            # beams must be free to diverge after inheriting one parent),
+            # so the reorder COPIES the parent rows' block contents into
+            # each child row's own blocks — the table itself is unchanged.
+            # Logical block j of child row r gets logical block j of its
+            # parent row: the same positions a dense row-gather would move.
+            # Unallocated entries copy trash→trash (all dst duplicates land
+            # on block 0, whose content is never attended unmasked).
+            table = cs["table"]
+            parent = (
+                jnp.arange(S, dtype=jnp.int32)[:, None] * K + beam_idx
+            ).reshape(-1)                              # [S*K] parent rows
+            src = jnp.take(table, parent, axis=0).reshape(-1)
+            dst = table.reshape(-1)
+
+            def copy_pool(c):
+                return c.at[dst].set(jnp.take(c, src, axis=0))
+
+            return {
+                "table": table,
+                "layers": [
+                    {"k": copy_pool(lc["k"]), "v": copy_pool(lc["v"])}
+                    for lc in cs["layers"]
+                ],
+            }
+
+        reorder_fn = reorder_paged if self.paged else reorder_all
         if self.cache_reorder == "gather":
-            caches = reorder_all(caches)
+            caches = reorder_fn(caches)
         else:
             # Delta reorder (PR 1): frozen/empty slots are identity, so a
             # steady-state running batch frequently skips the full-cache
             # gather — the property that keeps joins cheap.
             caches = jax.lax.cond(
                 jnp.all(beam_idx == arange_k),
-                lambda cs: cs, reorder_all, caches,
+                lambda cs: cs, reorder_fn, caches,
             )
         return dict(
             state, tok=new_tok.reshape(S * K), pos=new_pos,
@@ -734,7 +800,14 @@ class ContinuousBatcher:
                 c, z, (r0,) + (0,) * (c.ndim - 1)
             )
 
-        caches = jax.tree_util.tree_map(zero_rows, state["caches"])
+        if self.paged:
+            # No cache zeroing: position j is written (with real K/V) at
+            # step j, before the first step that unmasks it — stale block
+            # content is never attended. The block table itself is host
+            # state, pushed separately by the seat/release bookkeeping.
+            caches = state["caches"]
+        else:
+            caches = jax.tree_util.tree_map(zero_rows, state["caches"])
         tok = jax.lax.dynamic_update_slice(
             state["tok"],
             jnp.full((K,), self.start_id, dtype=jnp.int32),
@@ -783,13 +856,65 @@ class ContinuousBatcher:
             return 0.0
         return self.occupancy_sum / self.steps_run
 
+    # ---- paged-KV host allocator (ISSUE 16) ----
+
+    @property
+    def kv_blocks_total(self) -> int:
+        """Usable KV pool blocks (trash block excluded); 0 when dense."""
+        return (self.kv_pool_blocks - 1) if self.paged else 0
+
+    @property
+    def kv_blocks_free(self) -> int:
+        return len(self._free_blocks) if self.paged else 0
+
+    def _blocks_needed(self, limit: int) -> int:
+        """Seat-time reservation: the request's WORST CASE, every beam row
+        filled to ``limit`` — a seated request can never stall mid-decode."""
+        return self.K * (-(-limit // self.kv_block_size))
+
+    def _allocate_blocks(self, slot: int, limit: int) -> None:
+        per_row = -(-limit // self.kv_block_size)
+        ids: List[int] = []
+        for i in range(self.K):
+            r = slot * self.K + i
+            row_ids = [self._free_blocks.pop() for _ in range(per_row)]
+            self._table_np[r, :] = 0
+            self._table_np[r, :per_row] = row_ids
+            ids.extend(row_ids)
+        self._slot_blocks[slot] = ids
+        self._table_dirty = True
+
+    def _release_blocks(self, slot: int) -> None:
+        ids = self._slot_blocks.pop(slot, None)
+        if ids is None:
+            return
+        self._free_blocks.extend(ids)
+        # Repoint the freed rows to the trash block BEFORE their blocks can
+        # be reallocated: the freed slot's rows stay frozen in the batch and
+        # keep rewriting K/V at their final position every step.
+        self._table_np[slot * self.K:(slot + 1) * self.K, :] = 0
+        self._table_dirty = True
+
+    def _push_table(self) -> None:
+        if self.paged and self._table_dirty:
+            self._dyn["caches"]["table"] = jnp.asarray(self._table_np)
+            self._table_dirty = False
+
     def admit(
         self, enc_row, mask_row, limit: int, data: Any = None
     ) -> DecodeTicket:
         """Queue one request (prefill output + per-request token budget).
         Joins the running batch immediately if a slot is free, else waits in
-        the backlog and joins between steps as slots free up."""
+        the backlog and joins between steps as slots free up. Paged mode
+        raises :class:`KVPoolExhausted` for a request whose worst-case block
+        reservation exceeds the whole pool — it could never be seated."""
         limit = max(1, min(int(limit), self.T))
+        if self.paged and self._blocks_needed(limit) > self.kv_blocks_total:
+            raise KVPoolExhausted(
+                f"request needs {self._blocks_needed(limit)} KV blocks "
+                f"(limit={limit} × {self.K} beams, block_size="
+                f"{self.kv_block_size}), pool has {self.kv_blocks_total}"
+            )
         ticket = DecodeTicket(enc_row, mask_row, limit, data=data)
         ticket.admitted_wall = self._clock()
         self._backlog.append(ticket)
@@ -798,8 +923,19 @@ class ContinuousBatcher:
 
     def _fill_slots(self) -> None:
         while self._free and self._backlog:
+            if self.paged and (
+                self._blocks_needed(self._backlog[0].limit)
+                > len(self._free_blocks)
+            ):
+                # Head-of-line wait: FIFO admission order is part of the
+                # bit-identity contract (a later short request must not
+                # overtake), so the queue waits for releases, not for a
+                # smaller request.
+                break
             ticket = self._backlog.pop(0)
             slot = self._free.pop(0)
+            if self.paged:
+                self._allocate_blocks(slot, ticket.limit)
             self._dyn, self._stat = self._jinsert(
                 self._dyn, self._stat, np.int32(slot),
                 jnp.asarray(ticket.enc_row), jnp.asarray(ticket.mask_row),
@@ -828,6 +964,7 @@ class ContinuousBatcher:
             self._fill_slots()
             if not self._live:
                 return []
+        self._push_table()
         self._dyn = self._jstep(self._dyn, self._stat)
         self.steps_run += self.micro_steps
         self.occupancy_sum += len(self._live) * self.micro_steps
@@ -846,6 +983,8 @@ class ContinuousBatcher:
                 self.tokens_emitted += max(ticket.steps, ticket.length)
                 del self._live[slot]
                 self._free.append(slot)
+                if self.paged:
+                    self._release_blocks(slot)
                 finished.append(ticket)
         if finished:
             self._fill_slots()

@@ -153,6 +153,7 @@ def attention(
     dtype: Any,
     cache: Optional[Dict[str, jax.Array]] = None,
     cache_index: Optional[jax.Array] = None,
+    block_table: Optional[jax.Array] = None,
     attn_fn=dot_product_attention,
 ) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
     """Multi-head attention; optional KV cache for autoregressive decode.
@@ -168,6 +169,19 @@ def attention(
     a running batch sit at different decode depths. The written values are
     identical to the scalar path's; only the addressing generalizes.
 
+    With ``block_table`` ([B, MAXB] int32, ISSUE 16) the cache is a **paged
+    pool**: ``k``/``v`` are ``[NB, H, BS, D]`` fixed-size blocks shared by
+    every row, and row ``b``'s logical position ``p`` lives in pool block
+    ``block_table[b, p // BS]`` at offset ``p % BS``. Pool block 0 is the
+    trash block: unallocated/released table entries point there, so a frozen
+    row's steady rewrite at its frozen position can never corrupt a block
+    that was reallocated to a live request. The read view gathers the row's
+    blocks and slices to the mask's key length, so the attention shapes —
+    and therefore the reduction trees and the bits — match the dense path
+    exactly; positions past a row's write point are masked, and
+    ``exp(NEG_INF - m)`` is exactly 0.0 in f32, so trash/garbage content
+    never contributes. Requires a vector ``cache_index``.
+
     ``attn_fn`` is the inner attention kernel — the sp ring path
     (``agent_tpu.parallel.ring.ring_attention``) substitutes here.
     """
@@ -177,6 +191,42 @@ def attention(
 
     if cache is not None:
         assert cache_index is not None
+        if block_table is not None:
+            if getattr(cache_index, "ndim", 0) != 1:
+                raise ValueError(
+                    "paged KV (block_table) requires a per-row vector "
+                    "cache_index"
+                )
+            bsz = block_table.shape[0]
+            maxb = block_table.shape[1]
+            bs = cache["k"].shape[2]                  # pool block size
+            lk = mask.shape[-1]
+            ji = cache_index // bs                    # [B] logical block
+            off = cache_index % bs                    # [B] offset in block
+            # Rows whose position ran past table coverage (frozen at the
+            # engine's max) write to the trash block, not a clamped real one.
+            blk = jnp.where(
+                ji < maxb,
+                jnp.take_along_axis(
+                    block_table, jnp.minimum(ji, maxb - 1)[:, None], axis=1
+                )[:, 0],
+                0,
+            )
+            # Scatter one K/V row per batch row: pool[blk[b], :, off[b]] =
+            # new_kv[b]. Duplicate (blk, off) pairs only ever collide at the
+            # trash block (allocated blocks are row-exclusive) — harmless.
+            pk = cache["k"].astype(dtype).at[blk, :, off].set(k[:, :, 0])
+            pv = cache["v"].astype(dtype).at[blk, :, off].set(v[:, :, 0])
+
+            def view(pool):
+                x = pool[block_table]                 # [B, MAXB, H, BS, D]
+                x = x.transpose(0, 2, 1, 3, 4)
+                x = x.reshape(bsz, pool.shape[1], maxb * bs, pool.shape[3])
+                return x[:, :, :lk]                   # dense-shape view
+
+            out = attn_fn(q, view(pk), view(pv), mask)
+            y = _proj_out(p["wo"], out, dtype)
+            return y, {"k": pk, "v": pv}
         if getattr(cache_index, "ndim", 0) == 1:
             # Per-row positions: one decode step (Lk == 1) written to each
             # row's own cache slot. Formulated as a one-hot select, NOT a
@@ -281,10 +331,12 @@ def decoder_block(
     dtype: Any,
     cache: Optional[Dict[str, jax.Array]] = None,
     cache_index: Optional[jax.Array] = None,
+    block_table: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
     h = layer_norm(p["ln1"], x)
     a, cache = attention(
-        p["attn"], h, h, self_mask, dtype, cache=cache, cache_index=cache_index
+        p["attn"], h, h, self_mask, dtype, cache=cache,
+        cache_index=cache_index, block_table=block_table,
     )
     x = x + a
     h = layer_norm(p["ln_x"], x)

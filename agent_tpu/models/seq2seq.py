@@ -119,8 +119,25 @@ def _decode_step(
     causal mask, per-row cache scatter) computes exactly the values the
     scalar path computes for a batch whose rows all share one position, so
     a slot's step stream is bit-identical to a solo scalar-step decode.
+
+    ``caches`` may be the **paged** pytree ``{"table": [B, MAXB] int32,
+    "layers": [{"k","v"}: [NB, H, BS, D]]}`` (``make_paged_cache_factory``,
+    ISSUE 16): layer caches become shared block pools indexed through the
+    per-row block table, detected structurally so the step signature — and
+    every caller — is unchanged. Paged decode requires the vector-``step``
+    form; the mask math is identical, and the attention layer slices its
+    paged view back to ``max_tgt_len`` so the emitted logits stay
+    bit-identical to a dense-cache decode.
     """
     dtype = cfg.compute_dtype
+    paged = isinstance(caches, dict) and "table" in caches
+    if paged and getattr(step, "ndim", 0) != 1:
+        raise ValueError(
+            "paged KV caches require per-row vector positions (the "
+            "continuous-batching step); scan decode uses dense caches"
+        )
+    table = caches["table"] if paged else None
+    layer_caches = caches["layers"] if paged else caches
     x = params["embed"].astype(dtype)[tok][:, None, :]  # [B, 1, d]
     positions = jnp.arange(cfg.max_tgt_len)
     if getattr(step, "ndim", 0) == 1:
@@ -136,15 +153,16 @@ def _decode_step(
         # Self-attention mask: attend to cache positions <= step.
         self_mask = (positions <= step).astype(jnp.int32)[None, None, None, :]
     enc_attn_mask = enc_mask[:, None, None, :]
-    new_caches = []
-    for block, cache in zip(params["dec"], caches):
+    new_layers = []
+    for block, cache in zip(params["dec"], layer_caches):
         x, cache = layers.decoder_block(
             block, x, self_mask, enc_out, enc_attn_mask, dtype,
-            cache=cache, cache_index=step,
+            cache=cache, cache_index=step, block_table=table,
         )
-        new_caches.append(cache)
+        new_layers.append(cache)
     x = layers.layer_norm(params["ln_dec"], x)[:, 0]  # [B, d]
     logits = jnp.dot(x.astype(dtype), params["embed"].astype(dtype).T)
+    new_caches = {"table": table, "layers": new_layers} if paged else new_layers
     return logits.astype(jnp.float32), new_caches
 
 
@@ -281,6 +299,55 @@ def make_cache_factory(cfg: Seq2SeqConfig):
 
     def factory(rows: int) -> list:
         return _empty_cache(cfg, rows)
+
+    return factory
+
+
+def make_paged_cache_factory(
+    cfg: Seq2SeqConfig, block_size: int = 16, pool_blocks: int = 0
+):
+    """``rows -> paged KV caches`` for the continuous engine (ISSUE 16).
+
+    Instead of ``rows × max_tgt_len`` dense reservation, each decoder layer
+    holds ONE shared pool of ``pool_blocks`` fixed-size KV blocks
+    ``[NB, H, block_size, d_head]`` plus a per-row block table
+    ``[rows, ceil(max_tgt_len / block_size)]`` mapping logical block →
+    pool block. Pool block 0 is reserved as the trash block (the engine
+    points unallocated/released entries there), so ``pool_blocks`` counts
+    one unusable block. ``pool_blocks=0`` auto-sizes to dense parity
+    (``rows * MAXB + 1``) — same worst-case HBM, no admission stalls; shrink
+    it to trade admission headroom for resident-memory savings, since live
+    requests only hold ``ceil(limit / block_size)`` blocks per row.
+    """
+    bs = int(block_size)
+    if bs < 1:
+        raise ValueError("block_size must be >= 1")
+    maxb = -(-cfg.max_tgt_len // bs)
+    d_head = cfg.d_model // cfg.n_heads
+
+    def factory(rows: int) -> dict:
+        nb = int(pool_blocks) or rows * maxb + 1
+        if nb < maxb + 1:
+            raise ValueError(
+                f"pool_blocks={nb} cannot seat one max-length row "
+                f"({maxb} blocks + trash)"
+            )
+        return {
+            "table": jnp.zeros((rows, maxb), dtype=jnp.int32),
+            "layers": [
+                {
+                    "k": jnp.zeros(
+                        (nb, cfg.n_heads, bs, d_head),
+                        dtype=cfg.compute_dtype,
+                    ),
+                    "v": jnp.zeros(
+                        (nb, cfg.n_heads, bs, d_head),
+                        dtype=cfg.compute_dtype,
+                    ),
+                }
+                for _ in range(cfg.n_dec_layers)
+            ],
+        }
 
     return factory
 

@@ -38,7 +38,10 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 # Numeric usage-block fields agents may stamp (anything else is dropped —
 # the wire is agent-controlled input).
-USAGE_FIELDS = ("device_s", "host_s", "flops", "rows", "chips", "wire_bytes")
+USAGE_FIELDS = (
+    "device_s", "host_s", "flops", "rows", "chips", "wire_bytes",
+    "cache_hit_rows",
+)
 
 _ZERO = {
     "tasks": 0,
@@ -48,6 +51,10 @@ _ZERO = {
     "flops": 0.0,
     "rows": 0,
     "wire_bytes": 0,
+    # Rows whose prefill was served from the prefix cache (ISSUE 16): the
+    # showback line that says how much compute a tenant's repeated prefixes
+    # DIDN'T cost the fleet.
+    "cache_hit_rows": 0,
 }
 
 
@@ -79,6 +86,7 @@ def _accumulate(bucket: Dict[str, Any], usage: Mapping[str, float],
     bucket["flops"] += usage.get("flops", 0.0)
     bucket["rows"] += int(usage.get("rows", 0))
     bucket["wire_bytes"] += int(wire_bytes) + int(usage.get("wire_bytes", 0))
+    bucket["cache_hit_rows"] += int(usage.get("cache_hit_rows", 0))
 
 
 def _rounded(bucket: Mapping[str, Any]) -> Dict[str, Any]:
@@ -90,6 +98,7 @@ def _rounded(bucket: Mapping[str, Any]) -> Dict[str, Any]:
         "flops": float(bucket["flops"]),
         "rows": int(bucket["rows"]),
         "wire_bytes": int(bucket["wire_bytes"]),
+        "cache_hit_rows": int(bucket["cache_hit_rows"]),
     }
 
 

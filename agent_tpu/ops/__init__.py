@@ -60,6 +60,11 @@ OP_TO_MODULE: Dict[str, str] = {
     # batched interactive classify + the continuous-batching decode engine.
     "serve_classify": "serve_infer",
     "serve_summarize": "serve_infer",
+    # Disaggregated serving pools (ISSUE 16): prefill and decode as
+    # separate ops so the fleets can split (SERVE_DISAGG=1), chained via
+    # dep-gating like the MPMD stages.
+    "serve_prefill": "serve_infer",
+    "serve_decode": "serve_infer",
     "read_csv_shard": "csv_shard",       # name == registered name (gap 3 fixed)
     "risk_accumulate": "risk_accumulate",
     "trigger_sap": "trigger_sap",        # now a real registered op (gap 4 fixed)

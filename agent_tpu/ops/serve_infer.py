@@ -42,10 +42,36 @@ from agent_tpu.utils.errors import bad_input
 # execute paths — the TPU single-owner rule), so no lock.
 _ENGINES: Dict[Tuple, Any] = {}
 
+# Process-wide prefix cache (ISSUE 16), rebuilt when its knobs change.
+_PREFIX_CACHE: Any = None
+_PREFIX_KNOBS: Optional[Tuple] = None
+
 
 def reset_engines() -> None:
     """Drop every cached engine (tests; a fresh runtime invalidates them)."""
+    global _PREFIX_CACHE, _PREFIX_KNOBS
     _ENGINES.clear()
+    _PREFIX_CACHE = None
+    _PREFIX_KNOBS = None
+
+
+def _get_prefix_cache(serve):
+    """The process prefix cache per the active knobs, or ``None`` when
+    disabled."""
+    global _PREFIX_CACHE, _PREFIX_KNOBS
+    if not serve.prefix_cache_enabled or serve.prefix_cache_entries < 1 \
+            or serve.prefix_cache_mb <= 0:
+        return None
+    knobs = (serve.prefix_cache_entries, serve.prefix_cache_mb)
+    if _PREFIX_CACHE is None or _PREFIX_KNOBS != knobs:
+        from agent_tpu.ops.prefix_cache import PrefixCache
+
+        _PREFIX_CACHE = PrefixCache(
+            max_entries=serve.prefix_cache_entries,
+            max_bytes=int(serve.prefix_cache_mb * 2 ** 20),
+        )
+        _PREFIX_KNOBS = knobs
+    return _PREFIX_CACHE
 
 
 def _clamp_ttft(first_wall: Optional[float], arrived: Any) -> Optional[float]:
@@ -169,15 +195,15 @@ def _runtime(ctx):
     return get_runtime()
 
 
-def _serve_knobs(ctx) -> Tuple[int, int]:
-    """(decode_slots, micro_steps) from the agent config (SERVE_* env)."""
+def _serve_knobs(ctx):
+    """The agent's :class:`~agent_tpu.config.ServeConfig` (SERVE_* env)."""
     cfg = getattr(ctx, "config", None) if ctx is not None else None
     serve = getattr(cfg, "serve", None) if cfg is not None else None
     if serve is None:
         from agent_tpu.config import ServeConfig
 
         serve = ServeConfig.from_env()
-    return int(serve.decode_slots), int(serve.decode_micro_steps)
+    return serve
 
 
 def stage(payload: Any, ctx: Optional[object] = None):
@@ -268,23 +294,34 @@ def _get_params(runtime, model_id: str, cfg):
     )
 
 
-def _get_engine(runtime, params, state, slots: int, micro_steps: int = 1):
+def _get_engine(runtime, params, state, serve):
     from agent_tpu.models import seq2seq
     from agent_tpu.models.decoding import ContinuousBatcher
     from agent_tpu.models.tokenizer import BOS_ID, EOS_ID, PAD_ID
     from agent_tpu.ops._model_common import cfg_key
 
     cfg = state["cfg"]
+    slots = int(serve.decode_slots)
+    micro_steps = int(serve.decode_micro_steps)
+    paged = serve.kv_layout == "paged"
     key = (
         id(runtime), state["model_id"], cfg_key(cfg), state["bucket"],
         state["num_beams"], state["min_length"], state["length_penalty"],
         state["early_stopping"], slots, micro_steps,
+        serve.kv_layout, serve.kv_block_size, serve.kv_pool_blocks,
     )
     engine = _ENGINES.get(key)
     if engine is None:
+        if paged:
+            cache_factory = seq2seq.make_paged_cache_factory(
+                cfg, block_size=serve.kv_block_size,
+                pool_blocks=serve.kv_pool_blocks,
+            )
+        else:
+            cache_factory = seq2seq.make_cache_factory(cfg)
         engine = ContinuousBatcher(
             seq2seq.make_positional_step(params, cfg),
-            seq2seq.make_cache_factory(cfg),
+            cache_factory,
             slots=slots,
             vocab_size=cfg.vocab_size,
             max_tokens=cfg.max_tgt_len,
@@ -301,41 +338,98 @@ def _get_engine(runtime, params, state, slots: int, micro_steps: int = 1):
     return engine
 
 
-def serve_admit(state: Dict[str, Any], ctx: Optional[object] = None
-                ) -> Dict[str, Any]:
-    """Device phase, part 1 — prefill as its own batched step, then join
-    the continuous engine (between decode iterations, never inside one).
-    Returns the handle the runner pumps."""
+def _prefill_rows(runtime, params, state, serve):
+    """Prefill this batch: prefix-cache hits come back from host RAM, only
+    the MISS rows run the compiled encoder. Returns
+    ``(enc f32 [B, Ls, d_model], prefix delta dict)``.
+
+    A hit row is the exact ``float32`` array the cold prefill produced
+    when it populated the cache — bit-identical by construction. The miss
+    rows compile per distinct miss count (like the batch dim already did);
+    length buckets keep that key space small.
+    """
     import jax
 
+    ids, lengths = state["ids"], state["lengths"]
+    B, Ls = ids.shape
+    cfg, model_id = state["cfg"], state["model_id"]
+    cache = _get_prefix_cache(serve)
+    enc = np.zeros((B, Ls, cfg.d_model), dtype=np.float32)
+    hit = np.zeros((B,), dtype=bool)
+    keys: List[Optional[str]] = [None] * B
+    if cache is not None:
+        from agent_tpu.ops.prefix_cache import prefix_key
+
+        version = _params_key(model_id, cfg)
+        for i in range(B):
+            keys[i] = prefix_key(version, ids[i])
+            row = cache.get(keys[i])
+            if row is not None:
+                enc[i] = row
+                hit[i] = True
+    miss = np.nonzero(~hit)[0]
+    ev0 = cache.evictions if cache is not None else 0
+    if miss.size:
+
+        def build(Ls=Ls, n=int(miss.size)):
+            import jax.numpy as jnp
+
+            from agent_tpu.models import seq2seq
+
+            def run_enc(p, i, nlen):
+                mask = (
+                    jnp.arange(Ls)[None, :] < nlen[:, None]
+                ).astype(jnp.int32)
+                out = seq2seq.encode(p, i.astype(jnp.int32), mask, cfg)
+                # f32 handoff like summarize_mpmd: a bf16→f32 widening is
+                # lossless and the engine re-casts to its compute dtype.
+                return out.astype(jnp.float32)
+
+            return jax.jit(run_enc)
+
+        from agent_tpu.ops._model_common import cfg_key
+
+        fn = runtime.compiled(
+            ("serve_prefill", model_id, int(miss.size), Ls, cfg_key(cfg)),
+            build,
+        )
+        got = np.asarray(
+            fn(params, ids[miss], lengths[miss])
+        )
+        enc[miss] = got
+        if cache is not None:
+            for j, i in enumerate(miss):
+                cache.put(keys[i], got[j])
+    return enc, {
+        "hits": int(hit.sum()),
+        "misses": int(miss.size),
+        "evictions": int(
+            (cache.evictions - ev0) if cache is not None else 0
+        ),
+    }
+
+
+def serve_admit(state: Dict[str, Any], ctx: Optional[object] = None
+                ) -> Dict[str, Any]:
+    """Device phase, part 1 — prefill as its own batched step (prefix-cache
+    hits skip it, ISSUE 16), then join the continuous engine (between
+    decode iterations, never inside one). Returns the handle the runner
+    pumps. Disaggregated decode jobs arrive with ``enc_rows`` already in
+    the state (the serve_prefill agent's b1-wire handoff) and skip prefill
+    entirely."""
     runtime = _runtime(ctx)
     cfg, model_id = state["cfg"], state["model_id"]
     params = _get_params(runtime, model_id, cfg)
-    slots, micro_steps = _serve_knobs(ctx)
-    engine = _get_engine(runtime, params, state, slots, micro_steps)
-    ids, lengths = state["ids"], state["lengths"]
-    B, Ls = ids.shape
-
-    def build(Ls=Ls):
-        import jax.numpy as jnp
-
-        from agent_tpu.models import seq2seq
-
-        def run_enc(p, i, nlen):
-            mask = (jnp.arange(Ls)[None, :] < nlen[:, None]).astype(jnp.int32)
-            enc = seq2seq.encode(p, i.astype(jnp.int32), mask, cfg)
-            # f32 handoff like summarize_mpmd: a bf16→f32 widening is
-            # lossless and the engine re-casts to its compute dtype.
-            return enc.astype(jnp.float32)
-
-        return jax.jit(run_enc)
-
-    from agent_tpu.ops._model_common import cfg_key
-
-    fn = runtime.compiled(
-        ("serve_prefill", model_id, B, Ls, cfg_key(cfg)), build
-    )
-    enc = np.asarray(fn(params, ids, lengths))
+    serve = _serve_knobs(ctx)
+    engine = _get_engine(runtime, params, state, serve)
+    if state.get("enc_rows") is not None:
+        enc = np.asarray(state.pop("enc_rows"), dtype=np.float32)
+        prefix = state.pop("prefix", None) or {
+            "hits": 0, "misses": 0, "evictions": 0,
+        }
+    else:
+        enc, prefix = _prefill_rows(runtime, params, state, serve)
+    Ls = state["ids"].shape[1]
     masks = (
         np.arange(Ls)[None, :] < state["lengths"][:, None]
     ).astype(np.int32)
@@ -354,6 +448,7 @@ def serve_admit(state: Dict[str, Any], ctx: Optional[object] = None
         "engine": engine,
         "tickets": tickets,
         "state": state,
+        "prefix": prefix,
         "t_admit": t_admit,
         "steps0": steps0,
         "occ0": occ0,
@@ -384,6 +479,9 @@ def serve_collect(handle: Dict[str, Any]) -> Dict[str, Any]:
         "device": handle["device"],
         "occupancy": round(d_occ / d_steps, 3),
         "max_occupancy": engine.max_occupancy,
+        "prefix": handle.get("prefix"),
+        "kv_blocks_total": engine.kv_blocks_total,
+        "kv_blocks_free": engine.kv_blocks_free,
         "t_admit": handle["t_admit"],
         "t_device": time.perf_counter(),
     }
@@ -428,9 +526,24 @@ def finalize(executed: Dict[str, Any], ctx: Optional[object] = None
     from agent_tpu.ops._model_common import stamp_rows
 
     stamp_rows(ctx, len(results))
+    prefix = dict(executed.get("prefix") or {
+        "hits": 0, "misses": 0, "evictions": 0,
+    })
+    # A disaggregated decode job carries the PREFILL agent's counters
+    # forward (so the controller's reap sees them on the one job it
+    # watches) — but that agent already billed the cache hits; billing
+    # again here would double-count the saved prefill.
+    forwarded = bool(prefix.pop("forwarded", False))
+    if prefix.get("hits") and not forwarded and ctx is not None \
+            and hasattr(ctx, "tags"):
+        from agent_tpu.obs.usage import stamp_usage
+
+        # Saved prefill bills as cache hits — the showback line that says
+        # what a tenant's repeated prefixes DIDN'T cost (ISSUE 16).
+        stamp_usage(ctx.tags, cache_hit_rows=float(prefix["hits"]))
     return {
         "ok": True,
-        "op": "serve_summarize",
+        "op": state.get("op_name", "serve_summarize"),
         "device": executed["device"],
         "model": state["model_id"],
         "num_beams": state["num_beams"],
@@ -438,6 +551,9 @@ def finalize(executed: Dict[str, Any], ctx: Optional[object] = None
         "results": results,
         "occupancy": executed["occupancy"],
         "max_occupancy": executed["max_occupancy"],
+        "prefix_cache": prefix,
+        "kv_blocks_total": executed.get("kv_blocks_total", 0),
+        "kv_blocks_free": executed.get("kv_blocks_free", 0),
         "elapsed_ms": (time.perf_counter() - state["t0"]) * 1000.0,
     }
 
@@ -461,3 +577,139 @@ run_summarize.serve_admit = serve_admit
 run_summarize.serve_pump = serve_pump
 run_summarize.serve_done = serve_done
 run_summarize.serve_collect = serve_collect
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode pools (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+@register_op("serve_prefill")
+def run_prefill(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    """Prefill half of the disaggregated pool split (``SERVE_DISAGG=1``):
+    tokenize the batch and run the prefix-cached encoder forward, posting
+    the encoded rows as this job's RESULT — binary (b1) columns to a
+    negotiated controller, plain JSON floats otherwise. Both decode to the
+    identical f32 rows (exact bit patterns on b1; exact float→double→float
+    round trip on JSON, the ``summarize_mpmd`` argument), so the decode
+    pool resumes bit-identically either way. The dep-gated ``serve_decode``
+    job receives this result as its ``partials``."""
+    phase, state = stage(payload, ctx)
+    if phase == "done":
+        return state
+    runtime = _runtime(ctx)
+    params = _get_params(runtime, state["model_id"], state["cfg"])
+    serve = _serve_knobs(ctx)
+    enc, prefix = _prefill_rows(runtime, params, state, serve)
+    if ctx is not None and hasattr(ctx, "tags"):
+        ctx.tags.setdefault("timings", {}).update(
+            stage_ms=round((state["t_staged"] - state["t0"]) * 1e3, 3),
+        )
+        if prefix.get("hits"):
+            from agent_tpu.obs.usage import stamp_usage
+
+            # The prefill agent is where the saved work lives in disagg
+            # mode, so cache hits bill HERE (the decode job forwards the
+            # counters for metrics only — see finalize).
+            stamp_usage(ctx.tags, cache_hit_rows=float(prefix["hits"]))
+    out: Dict[str, Any] = {
+        "ok": True,
+        "op": "serve_prefill",
+        "device": runtime.platform,
+        "model": state["model_id"],
+        "n_requests": len(state["reqs"]),
+        "bucket": state["bucket"],
+        "prefix_cache": prefix,
+        "elapsed_ms": (time.perf_counter() - state["t0"]) * 1000.0,
+    }
+    tags = getattr(ctx, "tags", None) if ctx is not None else None
+    if isinstance(tags, dict) and tags.get("wire") == "b1":
+        from agent_tpu.data import wire
+
+        return wire.attach_result_columns(out, {
+            "enc_rows": np.ascontiguousarray(enc),
+            "lengths": np.ascontiguousarray(state["lengths"]),
+        })
+    out["enc_rows"] = enc.tolist()
+    out["lengths"] = state["lengths"].astype(int).tolist()
+    return out
+
+
+def _handoff_rows(
+    payload: Dict[str, Any], state: Dict[str, Any]
+) -> Tuple[np.ndarray, Dict[str, Any]]:
+    """The serve_prefill result riding this decode job: ``encoded`` (one
+    result object — tests, manual chains) or dep-gated ``partials`` (the
+    controller's lease-time materialization). Returns the f32 encoded rows
+    and the prefill agent's prefix-cache delta, marked ``forwarded`` so the
+    decode side reports it without re-billing it."""
+    if "encoded" in payload:
+        sources: Any = [payload["encoded"]]
+    elif "partials" in payload:
+        sources = payload["partials"]
+    else:
+        raise ValueError(
+            "serve_decode requires 'encoded' (one serve_prefill result) or "
+            "dep-gated 'partials'"
+        )
+    if not isinstance(sources, list) or len(sources) != 1:
+        raise ValueError(
+            "serve_decode expects exactly one prefill result to resume from"
+        )
+    src = sources[0]
+    if not (
+        isinstance(src, dict) and src.get("ok") is True
+        and src.get("op") == "serve_prefill"
+    ):
+        raise ValueError("handoff is not an ok serve_prefill result")
+    enc = np.asarray(src.get("enc_rows"), dtype=np.float32)
+    B, Ls = state["ids"].shape
+    d_model = state["cfg"].d_model
+    if enc.ndim != 3 or enc.shape != (B, Ls, d_model):
+        raise ValueError(
+            f"handoff enc_rows shape {enc.shape} does not match the batch "
+            f"({B}, {Ls}, {d_model}) — prefill and decode saw different "
+            f"payloads?"
+        )
+    prefix = dict(src.get("prefix_cache") or {})
+    prefix["forwarded"] = True
+    return enc, prefix
+
+
+def _decode_stage(payload: Any, ctx: Optional[object] = None):
+    """serve_decode's stage: the ordinary serving stage plus the prefill
+    handoff — the encoded rows land in the state, so ``serve_admit`` skips
+    the encoder entirely (the whole point of the split pool). The byte
+    tokenizer is deterministic, so re-tokenizing the same texts here yields
+    the very ids/lengths the prefill stage hashed and encoded."""
+    phase, state = stage(payload, ctx)
+    if phase == "done":
+        return phase, state
+    try:
+        enc, prefix = _handoff_rows(payload, state)
+    except ValueError as exc:
+        return "done", bad_input(str(exc))
+    state["enc_rows"] = enc
+    state["prefix"] = prefix
+    state["op_name"] = "serve_decode"
+    return "staged", state
+
+
+@register_op("serve_decode")
+def run_decode(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
+    """Decode half of the disaggregated pool split: resume from the
+    serve_prefill result's encoded rows and run ONLY the continuous decode
+    engine — bit-identical to the colocated serve_summarize path, because
+    the engine is handed the very same f32 rows either way."""
+    phase, value = _decode_stage(payload, ctx)
+    if phase == "done":
+        return value
+    return finalize(execute(value, ctx), ctx)
+
+
+run_decode.stage = _decode_stage
+run_decode.execute = execute
+run_decode.finalize = finalize
+run_decode.serve_admit = serve_admit
+run_decode.serve_pump = serve_pump
+run_decode.serve_done = serve_done
+run_decode.serve_collect = serve_collect

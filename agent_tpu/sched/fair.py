@@ -27,6 +27,9 @@ arXiv:2412.14374 — applied to the lease protocol):
 - Bulk shards (``shard-*`` job ids) prefer **idle** agents: an agent whose
   advertised staged ``queue_depth`` exceeds ``SCHED_BUSY_QUEUE_DEPTH`` is
   deferred the same bounded way.
+- Disaggregated-serving prefill jobs (``serve_prefill``, ISSUE 16) prefer
+  agents that do **not** advertise ``serve_decode`` — encoder bursts stay
+  off the continuous-decode fleet — with the same bounded deferral.
 - Deep-queue agents get **shrunken grants**: the grant limit drops by the
   staged backlog beyond the busy threshold (floor 1), so a backed-up agent
   stops accumulating work it cannot start — the tf.data backpressure idea
@@ -169,6 +172,14 @@ class FairScheduler(Scheduler):
                 s -= 2.0
         if is_bulk(job) and ctx.queue_depth is not None:
             s -= 0.5 * max(0, int(ctx.queue_depth) - self.busy_queue_depth)
+        if job.op == "serve_prefill" and "serve_decode" in ctx.ops:
+            # Disaggregated serving (ISSUE 16): prefill is a bulk encoder
+            # burst; landing it on an agent that also runs the continuous
+            # decode engine steals decode iterations and blows TTFT. Steer
+            # it toward prefill-only agents the bounded way (same
+            # preference-never-starvation contract as the TPU rule): a
+            # decode-capable agent defers it up to placement_patience.
+            s -= 1.0
         return s
 
     def _placement_ok(self, job: Any, ctx: LeaseContext) -> bool:

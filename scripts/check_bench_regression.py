@@ -48,15 +48,19 @@ LOWER_BETTER = {
     "classify_p50_batch_ms",
     "wire_bytes_per_row",
     "controller_replay_compacted_sec",
-    # Serving latencies (ISSUE 15).
+    # Serving latencies (ISSUE 15/16) — the p99 tail is the product
+    # problem (BENCH_r07: p50 27.7ms, p99 1231ms), so it's tracked AND
+    # CI-enforced (ci.yml --enforce-fields).
     "serving_ttft_p50_ms",
     "serving_ttft_p99_ms",
+    "serving_disagg_ttft_p99_ms",
 }
 
 # Fields that are identity/config, not performance — never judged.
 SKIP_FIELDS = {
     "n_chips",
     "multichip_n_chips",
+    "host_cores",     # host shape, not a perf number (ISSUE 16)
     "value",          # duplicate of the flagship flat field
     "vs_baseline",    # derived from `value`
 }
@@ -77,6 +81,10 @@ PER_FIELD_TOLERANCE = {
     "serving_tok_per_sec": 0.35,
     "serving_beam_tok_per_sec": 0.25,
     "serving_beam_speedup_vs_static": 0.25,
+    # Disaggregated serving (ISSUE 16): same open-loop noise profile.
+    "serving_disagg_tok_per_sec": 0.35,
+    "serving_disagg_ttft_p99_ms": 0.35,
+    "serving_disagg_vs_colocated": 0.25,
 }
 
 
@@ -89,7 +97,14 @@ def load_flat_fields(path: str) -> Optional[Dict[str, float]]:
     """Numeric top-level fields of one artifact. Handles both the driver
     wrapper shape (``{"parsed": {...}}``) and a raw bench stdout JSON;
     returns None when the payload is missing/unparseable (BENCH_r04/r05
-    record ``parsed: null`` — a real state this must tolerate)."""
+    record ``parsed: null`` — a real state this must tolerate).
+
+    Fields the artifact names in its own ``starved_fields`` list are
+    dropped (ISSUE 16): a round run with fewer host cores than the leg
+    needs records the starvation, not the code — those numbers must
+    neither set baselines nor count as regressions (BENCH_r06's
+    scaling_efficiency 0.187 was a 1-core container, not a 5× slowdown).
+    """
     try:
         with open(path, encoding="utf-8") as f:
             doc = json.load(f)
@@ -99,9 +114,12 @@ def load_flat_fields(path: str) -> Optional[Dict[str, float]]:
         doc = doc["parsed"]
     if not isinstance(doc, dict):
         return None
+    starved = {
+        s for s in (doc.get("starved_fields") or []) if isinstance(s, str)
+    }
     out: Dict[str, float] = {}
     for key, value in doc.items():
-        if key in SKIP_FIELDS:
+        if key in SKIP_FIELDS or key in starved:
             continue
         if isinstance(value, bool) or not isinstance(value, (int, float)):
             continue

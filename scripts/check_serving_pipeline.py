@@ -16,7 +16,13 @@ through the same agent, and asserts the serving acceptance bar:
    results;
 5. the SLO engine judged the serving stream: the default
    ``interactive_ttft`` objective (metric: ttft) saw every completed
-   request.
+   request;
+6. (ISSUE 16) the DISAGGREGATED leg: with ``SERVE_DISAGG`` splitting every
+   summarize into a serve_prefill → serve_decode chain executed by two
+   SEPARATE in-process agents (one advertising only prefill, one only
+   decode + bulk), the summaries are bit-identical to a colocated run of
+   the same texts, and TTFT holds the same bound while a bulk drain runs
+   alongside on the decode agent.
 
 CPU-shape smoke (tiny models, JAX_PLATFORMS=cpu): wall target well under a
 minute of drain work. Exit 0 = all bars met.
@@ -46,6 +52,7 @@ BULK_ROWS = 1024
 BULK_SHARD = 128
 N_INFER = 24
 TTFT_BOUND_MS = 30_000.0   # generous: 1-core CI containers stall freely
+DISAGG_N = 10              # prefix-heavy: 10 requests over 3 shared docs
 
 
 def write_csv(path: str, rows: int) -> None:
@@ -109,6 +116,135 @@ def drain_reference(csv_path):
         return bulk_results(controller, shard_ids)
     finally:
         server.stop()
+
+
+def disagg_leg(csv_path) -> str:
+    """Bar 6 (ISSUE 16): prefill and decode on separate agents, outputs
+    bit-identical to the colocated path, TTFT bound held under bulk load.
+
+    Two stacks over the same texts: colocated (one agent advertising
+    ``serve_summarize``) and disaggregated (an agent advertising ONLY
+    ``serve_prefill`` plus an agent advertising ``serve_decode`` and the
+    bulk op — the KV handoff really crosses an agent boundary, with the
+    controller's dependency gating as the inter-stage queue). The engine
+    store is reset between stacks so both start cold."""
+    import requests
+
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.agent.pipeline import PipelineRunner
+    from agent_tpu.config import AgentConfig, Config, ServeConfig
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+    from agent_tpu.ops.serve_infer import reset_engines
+
+    texts = [
+        f"disagg shared context document {i % 3} "
+        + "with a common preamble clause " * 4
+        for i in range(DISAGG_N)
+    ]
+    params = {"model_config": TINY_S2S, "max_length": 6}
+
+    def run_stack(disagg, agent_specs, with_bulk):
+        reset_engines()
+        controller = Controller(
+            lease_ttl_sec=600.0,
+            serve=ServeConfig(max_wait_ms=10.0, max_batch=4,
+                              disaggregated=disagg),
+        )
+        server = ControllerServer(controller).start()
+        agents, threads = [], []
+        try:
+            for name, tasks in agent_specs:
+                cfg = Config(agent=AgentConfig(
+                    controller_url=server.url, agent_name=name,
+                    tasks=tasks, idle_sleep_sec=0.0,
+                ))
+                a = Agent(config=cfg, session=requests.Session())
+                a._profile = {"tier": "smoke"}
+                runner = PipelineRunner(a, depth=2)
+                th = threading.Thread(target=runner.run, daemon=True)
+                th.start()
+                agents.append(a)
+                threads.append(th)
+            sess = requests.Session()
+            r = sess.post(server.url + "/v1/infer", json={
+                "op": "summarize", "text": "warm the serving path",
+                "params": params,
+            }, timeout=600)
+            assert r.status_code == 200, r.text
+            assert r.json()["state"] == "done", r.json()
+
+            shard_ids = None
+            if with_bulk:
+                shard_ids, _ = controller.submit_csv_job(
+                    csv_path, total_rows=BULK_ROWS, shard_size=BULK_SHARD,
+                    map_op="map_classify_tpu",
+                    extra_payload={"text_field": "text",
+                                   "allow_fallback": False,
+                                   "result_format": "columnar",
+                                   "model_config": TINY_CLS},
+                )
+            rids = []
+            for text in texts:
+                r = sess.post(server.url + "/v1/infer", json={
+                    "op": "summarize", "text": text, "wait": False,
+                    "params": params,
+                }, timeout=30)
+                assert r.status_code == 200, r.text
+                rids.append(r.json()["req_id"])
+            snaps = [controller.wait_infer(rid, 300.0) for rid in rids]
+            for snap in snaps:
+                assert snap is not None and snap["state"] == "done", snap
+            ttfts = [s["ttft_ms"] for s in snaps
+                     if s.get("ttft_ms") is not None]
+            assert ttfts and max(ttfts) < TTFT_BOUND_MS, (
+                f"disagg TTFT bound breached: max {max(ttfts)}ms"
+            )
+            if with_bulk:
+                deadline = time.monotonic() + 600
+                while not controller.drained():
+                    assert time.monotonic() < deadline, controller.counts()
+                    time.sleep(0.02)
+                bulk_results(controller, shard_ids)  # all shards succeeded
+            if disagg:
+                ops = {
+                    controller.job(jid).op for jid in controller.results()
+                }
+                assert {"serve_prefill", "serve_decode"} <= ops, (
+                    f"disagg chain did not split: ops {sorted(ops)}"
+                )
+                hits = controller._m_serve_prefix.value(event="hits")
+                assert hits > 0, "shared-prefix mix produced no cache hits"
+            for a in agents:
+                a.running = False
+            for th in threads:
+                th.join(timeout=60)
+            return [s["result"]["summary"] for s in snaps], ttfts
+        finally:
+            for a in agents:
+                a.running = False
+            server.stop()
+
+    print("[serving-smoke] disaggregated leg: colocated reference ...",
+          flush=True)
+    colo, _ = run_stack(
+        False, [("smoke-colo", ("serve_summarize",))], with_bulk=False,
+    )
+    print("[serving-smoke] disaggregated leg: split agents + bulk ...",
+          flush=True)
+    dis, ttfts = run_stack(
+        True,
+        [("smoke-prefill", ("serve_prefill",)),
+         ("smoke-decode", ("serve_decode", "map_classify_tpu"))],
+        with_bulk=True,
+    )
+    assert dis == colo, (
+        "disaggregated summaries diverged from the colocated path"
+    )
+    return (
+        f"disagg {len(dis)} reqs bit-identical across split agents "
+        f"(ttft max {max(ttfts):.0f}ms under bulk)"
+    )
 
 
 def main() -> int:
@@ -272,11 +408,15 @@ def main() -> int:
             t.join(timeout=60)
         finally:
             server.stop()
+
+        # Bar 6 (ISSUE 16): the disaggregated prefill/decode leg.
+        disagg_line = disagg_leg(csv_path)
     print(
         f"[serving-smoke] OK: {len(snaps)} interactive requests "
         f"(ttft p50 {sorted(ttfts)[len(ttfts) // 2]:.0f}ms, "
         f"max {max(ttfts):.0f}ms), max occupancy {max_occ}, "
         f"bulk bit-identical over {len(reference)} shards, "
+        f"{disagg_line}, "
         f"wall {time.monotonic() - t_start:.1f}s"
     )
     return 0

@@ -124,9 +124,10 @@ def _expo_quantile(samples, family: str, q: float):
 
 
 def serving_summary(metrics_text, status):
-    """The serving row's feed (ISSUE 15): request-state counts off the
-    /v1/status serving block + TTFT p99 and running-batch occupancy off the
-    exposition. None when serving is disabled."""
+    """The serving row's feed (ISSUE 15/16): request-state counts off the
+    /v1/status serving block + TTFT p99, running-batch occupancy, prefix-
+    cache hit rate, and paged-KV pool occupancy off the exposition. None
+    when serving is disabled."""
     serving = (status or {}).get("serving") or {}
     if not serving.get("enabled"):
         return None
@@ -137,6 +138,9 @@ def serving_summary(metrics_text, status):
         "rejected": serving.get("rejected", 0),
         "ttft_p99_ms": None,
         "occupancy": None,
+        "prefix_hit_rate": None,
+        "kv_blocks_free": None,
+        "kv_blocks_total": None,
     }
     if metrics_text:
         try:
@@ -150,6 +154,26 @@ def serving_summary(metrics_text, status):
             if "agent" not in labels
         ]
         out["occupancy"] = max(occ) if occ else None
+        # Prefix-cache hit rate (ISSUE 16): cumulative hits/(hits+misses)
+        # off the event-labeled counter.
+        events = {}
+        for labels, v in samples.get("serve_prefix_cache_events_total", []):
+            if "agent" in labels:
+                continue
+            events[labels.get("event")] = events.get(
+                labels.get("event"), 0.0
+            ) + v
+        looked = events.get("hits", 0.0) + events.get("misses", 0.0)
+        if looked > 0:
+            out["prefix_hit_rate"] = events.get("hits", 0.0) / looked
+        # Paged-KV pool occupancy (ISSUE 16): free/total block gauges.
+        for key, fam in (("kv_blocks_free", "serve_kv_blocks_free"),
+                         ("kv_blocks_total", "serve_kv_blocks_total")):
+            vals = [
+                v for labels, v in samples.get(fam, [])
+                if "agent" not in labels
+            ]
+            out[key] = vals[-1] if vals else None
     return out
 
 
@@ -297,8 +321,9 @@ def render(health, status, rate, colors: Colors, trends=None,
         lines.append("")
 
     if serving is not None:
-        # Serving row (ISSUE 15): the /v1/infer front door at a glance —
-        # request states, TTFT p99, tok/s, running-batch occupancy.
+        # Serving row (ISSUE 15/16): the /v1/infer front door at a glance
+        # — request states, TTFT p99, tok/s, running-batch occupancy,
+        # prefix-cache hit rate, paged-KV pool fill.
         reqs = serving.get("requests") or {}
         req_s = " ".join(
             f"{k}={v}" for k, v in sorted(reqs.items())
@@ -314,6 +339,23 @@ def render(health, status, rate, colors: Colors, trends=None,
             f"  batches in flight: {serving.get('in_flight', 0)}"
             f"  429s: {serving.get('rejected', 0)}"
         )
+        hit_rate = serving.get("prefix_hit_rate")
+        kv_total = serving.get("kv_blocks_total")
+        kv_free = serving.get("kv_blocks_free")
+        if hit_rate is not None or kv_total:
+            used = (
+                (kv_total - (kv_free or 0)) if kv_total else None
+            )
+            kv_s = (
+                f"{bar(used / kv_total if kv_total else 0.0, 8)} "
+                f"{fmt_num(used, 0)}/{fmt_num(kv_total, 0)} blocks"
+                if kv_total else "-"
+            )
+            lines.append(
+                f"  prefix cache: "
+                f"{fmt_num((hit_rate or 0.0) * 100.0, 1)}% hit"
+                f"  kv pool: {kv_s}"
+            )
         lines.append(colors.paint(f"  requests: {req_s}", DIM))
         lines.append("")
 
