@@ -661,6 +661,13 @@ class ServeConfig:
     # into a serve_decode job — prefill-heavy work steers away from decode
     # agents so bulk prefills can't stall the running batch.
     disaggregated: bool = False            # SERVE_DISAGG
+    # ---- wide-event request log (ISSUE 17) ----
+    # Tail-based sampling of the per-request record ring: errors and the
+    # slowest-TTFT decile are ALWAYS kept; the healthy/fast remainder is
+    # kept with this probability (1.0 = keep everything, 0.0 = tail only).
+    reqlog_sample: float = 1.0             # SERVE_REQLOG_SAMPLE
+    # Bounded record ring capacity (memory is O(capacity), not O(requests)).
+    reqlog_capacity: int = 2048            # SERVE_REQLOG_CAPACITY
 
     @staticmethod
     def from_env() -> "ServeConfig":
@@ -698,6 +705,10 @@ class ServeConfig:
             ),
             prefix_cache_mb=max(0.0, env_float("PREFIX_CACHE_MB", 256.0)),
             disaggregated=env_bool("SERVE_DISAGG", False),
+            reqlog_sample=min(
+                1.0, max(0.0, env_float("SERVE_REQLOG_SAMPLE", 1.0))
+            ),
+            reqlog_capacity=max(1, env_int("SERVE_REQLOG_CAPACITY", 2048)),
         )
 
 

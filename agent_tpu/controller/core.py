@@ -91,6 +91,7 @@ from agent_tpu.obs.metrics import (
     render_snapshots,
 )
 from agent_tpu.obs.recorder import FlightRecorder, default_dump_path
+from agent_tpu.obs.reqlog import RequestLog, dominant_component
 from agent_tpu.obs.slo import SloTracker, parse_slo_spec
 from agent_tpu.obs.trace import TraceStore
 from agent_tpu.obs import trace as obs_trace
@@ -453,9 +454,31 @@ class Controller:
         self._m_serve_kv_free = m.gauge(
             "serve_kv_blocks_free",
             "Free paged KV blocks after the last serving batch drained")
+        # TTFT decomposition + per-token pace (ISSUE 17). One request's
+        # component observations telescope: bucket_wait + queue_wait +
+        # prefill + handoff + kv_wait + first_decode = its measured TTFT.
+        self._m_serve_ttft_component = m.histogram(
+            "serve_ttft_component_seconds",
+            "Serving TTFT decomposition per component: bucket_wait "
+            "(coalescing), queue_wait (job queue + lease), prefill "
+            "(encoder forward), handoff (prefill->decode transport, ~0 "
+            "colocated), kv_wait (engine admit -> seated), first_decode "
+            "(seated -> first token)", ("component",))
+        self._m_serve_tpot = m.histogram(
+            "serve_tpot_seconds",
+            "Serving time-per-output-token per op: per-request mean step "
+            "pace after the first token (requests with >= 2 decode steps)",
+            ("op",))
+        # Wide-event request log (ISSUE 17): one record per terminal
+        # request, tail-sampled, served at GET /v1/debug/requests.
+        self.reqlog: Optional[RequestLog] = None
         if self.serve_config.enabled:
+            self.reqlog = RequestLog(
+                capacity=self.serve_config.reqlog_capacity,
+                sample=self.serve_config.reqlog_sample,
+            )
             self.serve_door = ServeFrontDoor(
-                self.serve_config, clock=self._clock
+                self.serve_config, clock=self._clock, traces=self.traces
             )
         self.captures = CaptureCoordinator()
         # Built on first GET /v1/profile/host (a controller never asked for
@@ -2165,6 +2188,7 @@ class Controller:
                 self.serve_config.disaggregated and op == "serve_summarize"
             )
             job_id = f"serve-{uuid.uuid4().hex[:12]}"
+            pf_id: Optional[str] = None
             try:
                 if disagg:
                     pf_id = f"serve-pf-{uuid.uuid4().hex[:12]}"
@@ -2204,7 +2228,7 @@ class Controller:
                 })
                 self._note_serve_completions(completed)
             else:
-                door.mark_batched(batch, job_id)
+                door.mark_batched(batch, job_id, prefill_job_id=pf_id)
                 self._m_serve_batches.inc(
                     op=batch.key.op, reason=batch.reason
                 )
@@ -2212,6 +2236,38 @@ class Controller:
                     "serve_batch", job_id=job_id, op=batch.key.op,
                     n_requests=len(batch.requests), reason=batch.reason,
                 )
+                self._link_serve_batch(batch, job_id, pf_id)
+
+    def _link_serve_batch(
+        self,
+        batch: ServeBatch,
+        job_id: str,
+        prefill_job_id: Optional[str],
+    ) -> None:
+        """Cross-trace stitching for one flushed batch (ISSUE 17): the
+        batch job's root span gains one link per rider request, each rider's
+        request trace links back to the job(s) it rides — so GET
+        /v1/trace/{req_id} can inline the shared batch timeline and a job
+        trace names every request it carried."""
+        with self._lock:
+            job_ids = [j for j in (job_id, prefill_job_id) if j]
+            roots = {
+                j: self._jobs[j].root_span_id
+                for j in job_ids if j in self._jobs
+            }
+        for jid in job_ids:
+            self.traces.add_links(jid, roots.get(jid), [
+                obs_trace.span_link(
+                    r.req_id, r.root_span_id, kind="serve_request"
+                )
+                for r in batch.requests
+            ])
+        kinds = {job_id: "serve_batch_job", prefill_job_id: "serve_prefill_job"}
+        for r in batch.requests:
+            self.traces.add_links(r.req_id, r.root_span_id, [
+                obs_trace.span_link(jid, roots.get(jid), kind=kinds[jid])
+                for jid in job_ids
+            ])
 
     def _serve_pump(self) -> None:
         """Deadline-flush due buckets and reap terminal serve jobs — driven
@@ -2317,13 +2373,116 @@ class Controller:
                             self._m_serve_kv_free.set(float(kv_free))
                 self._note_serve_completions(completed)
 
+    # Wall-clock checkpoint chain of one request's road to its first token.
+    # Consecutive checkpoints bound one component, so the components
+    # TELESCOPE: their sum is first_token − arrival = the measured TTFT
+    # (modulo per-component clamping of cross-host clock skew to >= 0).
+    _TTFT_CHAIN = (
+        ("bucket_wait", "arrived_wall", "batched_wall"),
+        ("queue_wait", "batched_wall", "prefill_t0_wall"),
+        ("prefill", "prefill_t0_wall", "prefill_t1_wall"),
+        ("handoff", "prefill_t1_wall", "admitted_wall"),
+        ("kv_wait", "admitted_wall", "joined_wall"),
+        ("first_decode", "joined_wall", "first_token_wall"),
+    )
+
+    def _ttft_components(self, req: Any) -> Dict[str, float]:
+        """Per-request TTFT decomposition in ms, from the lifecycle walls
+        the engine/op stamped (``req.telemetry``) plus the front door's own
+        arrival/flush walls. Components with a missing endpoint (failed
+        before reaching it) are simply absent."""
+        walls: Dict[str, Any] = dict(req.telemetry or {})
+        walls["arrived_wall"] = req.arrived_wall
+        walls["batched_wall"] = req.batched_wall
+        out: Dict[str, float] = {}
+        for name, k0, k1 in self._TTFT_CHAIN:
+            w0, w1 = walls.get(k0), walls.get(k1)
+            if isinstance(w0, (int, float)) and isinstance(w1, (int, float)):
+                out[name] = round(max(0.0, (w1 - w0)) * 1e3, 3)
+        return out
+
+    def _synthesize_request_spans(
+        self,
+        req: Any,
+        outcome: str,
+        components: Dict[str, float],
+        tel: Dict[str, Any],
+    ) -> None:
+        """Close out the request trace (ISSUE 17): one child span per TTFT
+        component plus a ``decode`` span for the post-first-token stream,
+        then finish the ``infer`` root — so GET /v1/trace/{req_id} assembles
+        a complete, gap-free tree on its own (links stitch in the batch
+        job's timeline separately)."""
+        if req.root_span_id is None:
+            return
+        walls: Dict[str, Any] = dict(tel)
+        walls["arrived_wall"] = req.arrived_wall
+        walls["batched_wall"] = req.batched_wall
+        for name, k0, _k1 in self._TTFT_CHAIN:
+            ms = components.get(name)
+            w0 = walls.get(k0)
+            if ms is None or not isinstance(w0, (int, float)):
+                continue
+            attrs: Dict[str, Any] = {"component": name}
+            if name == "kv_wait":
+                # The seat delta; the pure KV-block stall inside it is the
+                # engine's own measurement.
+                attrs["kv_blocked_ms"] = tel.get("kv_wait_ms")
+                attrs["occupancy_at_join"] = tel.get("occupancy_at_join")
+            if name == "bucket_wait":
+                attrs["flush_reason"] = req.flush_reason
+                attrs["bucket"] = req.bucket
+            self.traces.add({
+                "trace_id": req.req_id,
+                "span_id": obs_trace.new_span_id(),
+                "parent_span_id": req.root_span_id,
+                "name": f"ttft.{name}",
+                "start_wall": float(w0),
+                "start_mono": float(w0),
+                "duration_ms": ms,
+                "process": "controller",
+                "attributes": attrs,
+            })
+        first = tel.get("first_token_wall")
+        done = tel.get("done_wall")
+        if isinstance(first, (int, float)) and isinstance(done, (int, float)):
+            self.traces.add({
+                "trace_id": req.req_id,
+                "span_id": obs_trace.new_span_id(),
+                "parent_span_id": req.root_span_id,
+                "name": "decode",
+                "start_wall": float(first),
+                "start_mono": float(first),
+                "duration_ms": round(max(0.0, done - first) * 1e3, 3),
+                "process": "controller",
+                "attributes": {
+                    "steps": tel.get("steps"), "tokens": req.tokens,
+                },
+            })
+        self.traces.finish(
+            req.req_id, req.root_span_id, self._clock(),
+            attributes={
+                "outcome": outcome,
+                "job_id": req.job_id,
+                "prefill_job_id": req.prefill_job_id,
+                "path": tel.get("path"),
+            },
+        )
+
     def _note_serve_completions(self, completed: List[Any]) -> None:
-        """Metrics + SLO feed for requests that just reached a terminal
-        state: latency into the default objectives, TTFT into the
-        ``metric: "ttft"`` ones (the default spec's interactive_ttft)."""
+        """Terminal-request bookkeeping: metrics + SLO feed (latency into
+        the default objectives, TTFT into the ``metric: "ttft"`` ones), the
+        TTFT component decomposition (histograms + synthesized request-trace
+        spans), and the wide-event request log (ISSUE 17)."""
         now = self._clock()
         for req in completed:
             ok = req.state == SERVE_DONE
+            outcome = "completed" if ok else "failed"
+            if not ok and isinstance(req.error, dict) \
+                    and req.error.get("type") == "DependencyFailed":
+                # The disagg cascade: decode riders killed by a dead
+                # prefill dependency are their own failure class.
+                outcome = "dep_failed"
             self._m_serve_requests.inc(
                 op=req.op, outcome="completed" if ok else "failed"
             )
@@ -2335,9 +2494,60 @@ class Controller:
                 self._m_serve_ttft.observe(req.ttft_ms / 1e3, op=req.op)
             if req.tokens:
                 self._m_serve_tokens.inc(req.tokens, op=req.op)
+            tel: Dict[str, Any] = (
+                req.telemetry if isinstance(req.telemetry, dict) else {}
+            )
+            components = self._ttft_components(req)
+            for name, ms in components.items():
+                self._m_serve_ttft_component.observe(
+                    ms / 1e3, component=name
+                )
+            tpot_ms: Optional[float] = None
+            steps = tel.get("steps")
+            first = tel.get("first_token_wall")
+            done = tel.get("done_wall")
+            if isinstance(steps, int) and steps >= 2 \
+                    and isinstance(first, (int, float)) \
+                    and isinstance(done, (int, float)):
+                tpot_ms = round(
+                    max(0.0, done - first) * 1e3 / (steps - 1), 3
+                )
+                self._m_serve_tpot.observe(tpot_ms / 1e3, op=req.op)
+            self._synthesize_request_spans(req, outcome, components, tel)
+            if self.reqlog is not None:
+                self.reqlog.add({
+                    "req_id": req.req_id,
+                    "tenant": req.tenant,
+                    "op": req.op,
+                    "bucket": req.bucket,
+                    "priority": req.priority,
+                    "outcome": outcome,
+                    "path": tel.get("path") or (
+                        "disagg" if req.prefill_job_id else "colocated"
+                    ),
+                    "ttft_ms": req.ttft_ms,
+                    "tpot_ms": tpot_ms,
+                    "latency_ms": req.latency_ms,
+                    "tokens": req.tokens,
+                    "steps": steps,
+                    "prefix_hit": bool(tel.get("cache_hit")),
+                    "kv_wait_ms": components.get("kv_wait"),
+                    "kv_blocked_ms": tel.get("kv_wait_ms"),
+                    "occupancy": tel.get("occupancy_at_join"),
+                    "flush_reason": req.flush_reason,
+                    "components": components,
+                    "dominant_component": dominant_component(components),
+                    "trace_id": req.req_id,
+                    "job_id": req.job_id,
+                    "prefill_job_id": req.prefill_job_id,
+                    "error": (
+                        req.error.get("type")
+                        if isinstance(req.error, dict) else None
+                    ),
+                })
             self.recorder.record(
                 "serve_done", req_id=req.req_id, op=req.op,
-                outcome="completed" if ok else "failed",
+                outcome=outcome,
                 ttft_ms=req.ttft_ms, latency_ms=req.latency_ms,
             )
             if self.slo is not None and req.latency_ms is not None:
@@ -2396,7 +2606,28 @@ class Controller:
         out: Dict[str, Any] = {"enabled": self.serve_door is not None}
         if self.serve_door is not None:
             out.update(self.serve_door.stats())
+        if self.reqlog is not None:
+            out["request_log"] = self.reqlog.stats()
         return out
+
+    def requests_json(
+        self,
+        tenant: Optional[str] = None,
+        outcome: Optional[str] = None,
+        slow: bool = False,
+        limit: int = 256,
+    ) -> Dict[str, Any]:
+        """The ``GET /v1/debug/requests`` body: newest-first wide-event
+        request records (tail-sampled) plus the log's keep/drop counters."""
+        if self.reqlog is None:
+            return {"enabled": False, "requests": []}
+        return {
+            "enabled": True,
+            "requests": self.reqlog.snapshot(
+                tenant=tenant, outcome=outcome, slow=slow, limit=limit
+            ),
+            "stats": self.reqlog.stats(),
+        }
 
     def note_http_bytes(self, route: str, direction: str, n: int) -> None:
         """Raw data-plane byte accounting, fed by the HTTP layer (request
@@ -2573,11 +2804,37 @@ class Controller:
         parts.append((liveness, {}))
         return render_snapshots(parts)
 
-    def trace_json(self, job_id: str) -> Optional[Dict[str, Any]]:
-        """Assembled span tree for one job (``GET /v1/trace/{job_id}``):
+    # Linked traces inlined per GET /v1/trace/{id} — enough for a serving
+    # batch's full rider list (SERVE_MAX_BATCH is 16 by default).
+    MAX_LINKED_TRACES = 32
+
+    def trace_json(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """Assembled span tree for one trace (``GET /v1/trace/{id}`` —
+        ``trace_id`` is a job id or, since ISSUE 17, a serving ``req_id``):
         spans sorted by wall start, orphans flagged, completeness = one root
-        + no orphans + every span closed. None for unknown traces."""
-        return self.traces.assemble(job_id)
+        + no orphans + every span closed. Traces whose spans carry cross-
+        trace ``links`` (a request ↔ its coalesced batch job) get the linked
+        traces assembled inline under ``linked_traces`` — the stitched view
+        spanning the disagg prefill → decode handoff. None for unknown
+        traces."""
+        assembled = self.traces.assemble(trace_id)
+        if assembled is None:
+            return None
+        linked: Dict[str, Dict[str, Any]] = {}
+        for span in assembled["spans"]:
+            for link in span.get("links") or ():
+                tid = link.get("trace_id")
+                if (
+                    isinstance(tid, str) and tid and tid != trace_id
+                    and tid not in linked
+                    and len(linked) < self.MAX_LINKED_TRACES
+                ):
+                    sub = self.traces.assemble(tid)
+                    if sub is not None:
+                        linked[tid] = sub
+        if linked:
+            assembled["linked_traces"] = list(linked.values())
+        return assembled
 
     def traces_json(self, limit: int = 20) -> List[Dict[str, Any]]:
         """Newest-first trace summaries (``GET /v1/traces?limit=N``)."""

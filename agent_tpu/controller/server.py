@@ -318,24 +318,32 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, {"traces": self.controller.traces_json(limit)})
             return
         if path.startswith("/v1/trace/"):
-            # Assembled span tree for one job. ?format=perfetto returns the
-            # Chrome-trace JSON Perfetto loads directly; ?format=jsonl the
-            # span-per-line dump; default is the assembled wire schema.
-            job_id = path[len("/v1/trace/"):]
-            assembled = self.controller.trace_json(job_id)
+            # Assembled span tree for one job — or one serving request
+            # (ISSUE 17: a req_id resolves to its stitched tree, the batch
+            # job traces it links to inlined under ``linked_traces``).
+            # ?format=perfetto returns the Chrome-trace JSON Perfetto loads
+            # directly; ?format=jsonl the span-per-line dump; default is
+            # the assembled wire schema.
+            trace_id = path[len("/v1/trace/"):]
+            assembled = self.controller.trace_json(trace_id)
             if assembled is None:
-                self._send(404, {"error": f"no trace for job {job_id!r}"})
+                self._send(404, {"error": f"no trace {trace_id!r}"})
                 return
             fmt = query.get("format", ["json"])[0]
+            # Flat exports flatten the stitched view: the trace's own spans
+            # plus every linked trace's, one timeline.
+            flat_spans = list(assembled["spans"])
+            for lt in assembled.get("linked_traces", ()):
+                flat_spans.extend(lt["spans"])
             if fmt == "perfetto":
                 from agent_tpu.obs.trace import to_chrome_trace
 
-                self._send(200, to_chrome_trace(assembled["spans"]))
+                self._send(200, to_chrome_trace(flat_spans))
             elif fmt == "jsonl":
                 from agent_tpu.obs.trace import to_jsonl
 
                 self._send_text(
-                    200, to_jsonl(assembled["spans"]),
+                    200, to_jsonl(flat_spans),
                     "application/jsonl; charset=utf-8",
                 )
             else:
@@ -344,16 +352,48 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/v1/debug/events":
             # Flight-recorder dump on demand — the controller half of the
             # post-hoc diagnosis story (the agent half is SIGUSR1).
-            # ?job_id= filters to one job's life (ISSUE 5 satellite).
+            # ?job_id= filters to one job's life (ISSUE 5 satellite);
+            # ?req_id= to one serving request's (ISSUE 17 satellite).
             job_id = query.get("job_id", [None])[0]
+            req_id = query.get("req_id", [None])[0]
             self._send(
                 200,
                 {
-                    "events": self.controller.recorder.events(job_id=job_id),
+                    "events": self.controller.recorder.events(
+                        job_id=job_id, req_id=req_id
+                    ),
                     "dropped": self.controller.recorder.dropped,
                     "capacity": self.controller.recorder.capacity,
                 },
             )
+            return
+        if path == "/v1/debug/requests":
+            # Wide-event request log (ISSUE 17): one tail-sampled record
+            # per terminal serving request. ?tenant= / ?outcome= filter,
+            # ?slow=1 restricts to the kept tail (errors + slow decile),
+            # ?limit=N caps, ?format=jsonl exports record-per-line.
+            try:
+                limit = int(query.get("limit", ["256"])[0])
+            except ValueError:
+                self._send(400, {"error": "limit must be an int"})
+                return
+            body = self.controller.requests_json(
+                tenant=query.get("tenant", [None])[0],
+                outcome=query.get("outcome", [None])[0],
+                slow=query.get("slow", ["0"])[0] in ("1", "true", "yes"),
+                limit=limit,
+            )
+            if query.get("format", ["json"])[0] == "jsonl":
+                self._send_text(
+                    200,
+                    "".join(
+                        json.dumps(rec, sort_keys=True, default=str) + "\n"
+                        for rec in body["requests"]
+                    ),
+                    "application/jsonl; charset=utf-8",
+                )
+            else:
+                self._send(200, body)
             return
         if path == "/v1/usage":
             # Showback report (ISSUE 9): billed device/host seconds, FLOPs,

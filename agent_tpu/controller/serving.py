@@ -90,6 +90,19 @@ class InferRequest:
     ttft_ms: Optional[float] = None
     latency_ms: Optional[float] = None
     tokens: int = 0
+    # ---- request-level observability (ISSUE 17) ----
+    bucket: int = 0                         # length bucket the request rode
+    flush_reason: Optional[str] = None      # "full" | "deadline"
+    batched_wall: Optional[float] = None
+    prefill_job_id: Optional[str] = None    # disagg: the serve_prefill leg
+    # The request's OWN trace (trace_id = req_id): root "infer" span plus
+    # the bucket-wait child, opened at submit, closed at flush/terminal.
+    root_span_id: Optional[str] = None
+    bucket_span_id: Optional[str] = None
+    # Per-request decode telemetry the agent ships inside its batch result
+    # entry (prefill/seat/first-token walls, KV wait, occupancy-at-join,
+    # prefix cache hit, steps) — the TTFT decomposition's raw material.
+    telemetry: Optional[Dict[str, Any]] = None
 
     def snapshot(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -160,9 +173,14 @@ class ServeFrontDoor:
         self,
         config: Optional[ServeConfig] = None,
         clock=time.monotonic,
+        traces=None,
     ) -> None:
         self.config = config if config is not None else ServeConfig()
         self._clock = clock
+        # Controller's TraceStore (ISSUE 17): each request opens its own
+        # trace (trace_id = req_id) with an "infer" root and a
+        # "bucket.wait" child closed at flush time. None = tracing off.
+        self._traces = traces
         self._cond = threading.Condition()
         self._requests: Dict[str, InferRequest] = {}
         self._buckets: "collections.OrderedDict[_BucketKey, List[InferRequest]]" = (
@@ -247,6 +265,7 @@ class ServeFrontDoor:
             op=op, tenant=req.tenant, priority=req.priority,
             bucket=self._bucket_len(text), sig=sig,
         )
+        req.bucket = key.bucket
         with self._cond:
             budget = self.config.max_pending
             if budget and self._pending_count_locked() + 1 > budget:
@@ -265,6 +284,23 @@ class ServeFrontDoor:
                 full.append(
                     ServeBatch(key, self._buckets.pop(key), reason="full")
                 )
+        # Open the request's OWN trace (outside the lock — the TraceStore
+        # has its own; admission rejections above never mint spans). The
+        # spans anchor at arrived_clock, so opening after enqueue costs no
+        # timing accuracy.
+        if self._traces is not None:
+            req.root_span_id = self._traces.open(
+                req.req_id, "infer", start_clock=req.arrived_clock,
+                attributes={
+                    "op": op, "tenant": req.tenant,
+                    "priority": req.priority, "bucket": key.bucket,
+                },
+            )
+            req.bucket_span_id = self._traces.open(
+                req.req_id, "bucket.wait", req.root_span_id,
+                start_clock=req.arrived_clock,
+                attributes={"bucket": key.bucket},
+            )
         return req, full
 
     def pop_due(self, now_clock: Optional[float] = None) -> List[ServeBatch]:
@@ -284,28 +320,56 @@ class ServeFrontDoor:
                     )
         return out
 
-    def mark_batched(self, batch: ServeBatch, job_id: str) -> None:
+    def mark_batched(
+        self,
+        batch: ServeBatch,
+        job_id: str,
+        prefill_job_id: Optional[str] = None,
+    ) -> None:
         now = self._clock()
+        now_wall = time.time()
         with self._cond:
             self._jobs[job_id] = [r.req_id for r in batch.requests]
             for r in batch.requests:
                 r.state = BATCHED
                 r.job_id = job_id
+                r.prefill_job_id = prefill_job_id
                 r.batched_clock = now
+                r.batched_wall = now_wall
+                r.flush_reason = batch.reason
             self._cond.notify_all()
+        # Close each rider's bucket-wait span with the flush verdict (why
+        # did it leave the bucket: full or deadline) and the job it rides.
+        if self._traces is not None:
+            for r in batch.requests:
+                attrs: Dict[str, Any] = {
+                    "reason": batch.reason, "job_id": job_id,
+                }
+                if prefill_job_id:
+                    attrs["prefill_job_id"] = prefill_job_id
+                self._traces.finish(
+                    r.req_id, r.bucket_span_id, now, attributes=attrs
+                )
 
     def fail_batch(self, batch: ServeBatch, error: Any) -> List[InferRequest]:
         """A flushed batch whose job submission was refused (admission on
         the job queue): every rider fails with the refusal."""
+        now = self._clock()
         with self._cond:
             for r in batch.requests:
                 r.state = FAILED
                 r.error = error
                 r.latency_ms = round(
-                    (self._clock() - r.arrived_clock) * 1e3, 3
+                    (now - r.arrived_clock) * 1e3, 3
                 )
                 self._retire_locked(r)
             self._cond.notify_all()
+        if self._traces is not None:
+            for r in batch.requests:
+                self._traces.finish(
+                    r.req_id, r.bucket_span_id, now,
+                    attributes={"reason": "rejected"},
+                )
         return list(batch.requests)
 
     # ---- completion fan-out ----
@@ -344,8 +408,11 @@ class ServeFrontDoor:
                 if ok and entry is not None:
                     req.state = DONE
                     req.result = {
-                        k: v for k, v in entry.items() if k != "req_id"
+                        k: v for k, v in entry.items()
+                        if k not in ("req_id", "telemetry")
                     }
+                    tel = entry.get("telemetry")
+                    req.telemetry = tel if isinstance(tel, dict) else None
                     ttft = entry.get("ttft_ms")
                     req.ttft_ms = (
                         round(float(ttft), 3)

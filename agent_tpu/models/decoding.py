@@ -399,12 +399,22 @@ def beam_scan(
 
 class DecodeTicket:
     """One request's seat in the continuous engine: the prefill handoff in,
-    the emitted tokens (and TTFT/occupancy bookkeeping) out."""
+    the emitted tokens (and TTFT/occupancy bookkeeping) out.
+
+    Per-slot lifecycle telemetry (ISSUE 17): beyond the admit/join/first-
+    token/done walls the ticket records how long it waited on KV-block
+    availability (``kv_wait_s`` — the paged pool's FIFO head-of-line wait),
+    the engine step count at join, the running-batch occupancy the moment
+    it was seated, and an ordered ``events`` list of ``(name, wall)``
+    lifecycle stamps (``admit``/``kv_wait``/``seat``/``first_token``/
+    ``exit``) for the request trace."""
 
     __slots__ = (
         "data", "limit", "enc_row", "mask_row", "slot",
         "admitted_wall", "joined_wall", "first_token_wall", "done_wall",
         "tokens", "length", "steps",
+        "kv_wait_start", "kv_wait_s", "join_step", "occupancy_at_join",
+        "events",
     )
 
     def __init__(self, enc_row, mask_row, limit: int, data: Any = None):
@@ -420,6 +430,11 @@ class DecodeTicket:
         self.tokens: Optional[np.ndarray] = None
         self.length: int = 0
         self.steps: int = 0
+        self.kv_wait_start: Optional[float] = None
+        self.kv_wait_s: float = 0.0
+        self.join_step: int = 0
+        self.occupancy_at_join: int = 0
+        self.events: List[Tuple[str, float]] = []
 
 
 class ContinuousBatcher:
@@ -917,6 +932,7 @@ class ContinuousBatcher:
             )
         ticket = DecodeTicket(enc_row, mask_row, limit, data=data)
         ticket.admitted_wall = self._clock()
+        ticket.events.append(("admit", ticket.admitted_wall))
         self._backlog.append(ticket)
         self._fill_slots()
         return ticket
@@ -930,7 +946,12 @@ class ContinuousBatcher:
                 # Head-of-line wait: FIFO admission order is part of the
                 # bit-identity contract (a later short request must not
                 # overtake), so the queue waits for releases, not for a
-                # smaller request.
+                # smaller request. Stamp the KV-wait start once (ISSUE 17)
+                # — the wait ends when the head finally seats below.
+                head = self._backlog[0]
+                if head.kv_wait_start is None:
+                    head.kv_wait_start = self._clock()
+                    head.events.append(("kv_wait", head.kv_wait_start))
                 break
             ticket = self._backlog.pop(0)
             slot = self._free.pop(0)
@@ -943,8 +964,17 @@ class ContinuousBatcher:
             )
             ticket.slot = slot
             ticket.joined_wall = self._clock()
+            if ticket.kv_wait_start is not None:
+                ticket.kv_wait_s = max(
+                    0.0, ticket.joined_wall - ticket.kv_wait_start
+                )
+            ticket.join_step = self.steps_run
             ticket.enc_row = ticket.mask_row = None  # joined: free the host copy
             self._live[slot] = ticket
+            # Occupancy the moment this request was seated (itself
+            # included) — the "how crowded was the batch I joined" signal.
+            ticket.occupancy_at_join = len(self._live)
+            ticket.events.append(("seat", ticket.joined_wall))
 
     def _extract(self, slot: int) -> Tuple[np.ndarray, int]:
         if self.beam:
@@ -976,10 +1006,12 @@ class ContinuousBatcher:
         for slot, ticket in list(self._live.items()):
             if ticket.first_token_wall is None and pos[slot] >= 1:
                 ticket.first_token_wall = now
+                ticket.events.append(("first_token", now))
             if done[slot]:
                 ticket.steps = int(pos[slot])
                 ticket.tokens, ticket.length = self._extract(slot)
                 ticket.done_wall = now
+                ticket.events.append(("exit", now))
                 self.tokens_emitted += max(ticket.steps, ticket.length)
                 del self._live[slot]
                 self._free.append(slot)

@@ -71,14 +71,19 @@ class FlightRecorder:
             self._events.append(event)
 
     def events(
-        self, job_id: Optional[str] = None
+        self,
+        job_id: Optional[str] = None,
+        req_id: Optional[str] = None,
     ) -> List[Dict[str, Any]]:
-        """All buffered events, optionally filtered to one job's life
-        (the ``GET /v1/debug/events?job_id=`` surface)."""
+        """All buffered events, optionally filtered to one job's life or
+        one serving request's (the ``GET /v1/debug/events?job_id=`` /
+        ``?req_id=`` surfaces). Both filters AND together."""
         with self._lock:
             out = list(self._events)
         if job_id is not None:
             out = [e for e in out if e.get("job_id") == job_id]
+        if req_id is not None:
+            out = [e for e in out if e.get("req_id") == req_id]
         return out
 
     @property

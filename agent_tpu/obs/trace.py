@@ -18,6 +18,11 @@ Shapes:
   monotonic-start + duration pair for exact intra-process math and a
   wall-clock anchor (``start_wall``) for cross-process ordering. The wire
   format is the plain dict (``Span.to_wire`` / any dict with the same keys).
+  A span may additionally carry ``links`` — causal references to spans in
+  *other* traces (ISSUE 17: a coalesced serving batch job links back to
+  each rider request's trace). Links never replace the single parent; the
+  key is emitted only when non-empty, so legacy span bytes are unchanged
+  when no links exist.
 - **SpanBuffer** — the per-process bounded ring agents record into
   (O(capacity) like the flight recorder). ``drain()`` pops everything
   pending so the agent can piggyback spans onto ``POST /v1/results`` and
@@ -119,9 +124,15 @@ class Span:
     duration_ms: Optional[float] = None
     process: str = ""
     attributes: Dict[str, Any] = field(default_factory=dict)
+    # Cross-trace causal references (ISSUE 17): each entry is
+    # {"trace_id": ..., "span_id": ...?, "attributes": {...}?}. Links do
+    # NOT participate in the parent/child tree — assembly ignores them —
+    # and the wire key is omitted entirely when the list is empty so a
+    # link-free span serializes byte-identically to the pre-links schema.
+    links: List[Dict[str, Any]] = field(default_factory=list)
 
     def to_wire(self) -> Dict[str, Any]:
-        return {
+        wire = {
             "trace_id": self.trace_id,
             "span_id": self.span_id,
             "parent_span_id": self.parent_span_id,
@@ -132,6 +143,9 @@ class Span:
             "process": self.process,
             "attributes": dict(self.attributes),
         }
+        if self.links:
+            wire["links"] = [dict(link) for link in self.links]
+        return wire
 
 
 def make_span(
@@ -144,15 +158,16 @@ def make_span(
     process: str = "",
     span_id: Optional[str] = None,
     attributes: Optional[Mapping[str, Any]] = None,
+    links: Optional[Sequence[Mapping[str, Any]]] = None,
 ) -> Dict[str, Any]:
     """A closed span wire dict from a measured ``(start_mono, duration)``
     pair, back-deriving the wall anchor from the current clocks so callers
     never run two clocks for one measurement. Builds the wire dict directly
     (no ``Span`` round-trip): this runs several times per task on the drain
-    hot path."""
+    hot path. ``links`` is emitted only when non-empty (legacy bytes)."""
     now_mono = time.monotonic()
     start_mono = now_mono if start_mono is None else float(start_mono)
-    return {
+    span = {
         "trace_id": trace_id,
         "span_id": span_id or new_span_id(),
         "parent_span_id": parent_span_id,
@@ -165,6 +180,25 @@ def make_span(
         "process": process,
         "attributes": dict(attributes or {}),
     }
+    if links:
+        span["links"] = [dict(link) for link in links]
+    return span
+
+
+def span_link(
+    trace_id: str,
+    span_id: Optional[str] = None,
+    **attributes: Any,
+) -> Dict[str, Any]:
+    """One link entry for a span's ``links`` list: a causal reference into
+    ANOTHER trace (the serving batch job ↔ rider request association).
+    ``span_id``/``attributes`` are optional and omitted when empty."""
+    link: Dict[str, Any] = {"trace_id": str(trace_id)}
+    if span_id:
+        link["span_id"] = str(span_id)
+    if attributes:
+        link["attributes"] = dict(attributes)
+    return link
 
 
 def _valid_span(span: Any) -> bool:
@@ -415,6 +449,7 @@ class TraceStore:
         process: str = "controller",
         attributes: Optional[Mapping[str, Any]] = None,
         span_id: Optional[str] = None,
+        links: Optional[Sequence[Mapping[str, Any]]] = None,
     ) -> Optional[str]:
         """Record an OPEN span (duration unknown yet) and return its id, or
         None when tracing is disabled. ``start_clock`` is whatever monotonic
@@ -423,7 +458,7 @@ class TraceStore:
         if not enabled():
             return None
         sid = span_id or new_span_id()
-        ok = self.add({
+        span: Dict[str, Any] = {
             "trace_id": trace_id,
             "span_id": sid,
             "parent_span_id": parent_span_id,
@@ -433,8 +468,35 @@ class TraceStore:
             "duration_ms": None,
             "process": process,
             "attributes": dict(attributes or {}),
-        })
+        }
+        if links:
+            span["links"] = [dict(link) for link in links]
+        ok = self.add(span)
         return sid if ok else None
+
+    def add_links(
+        self,
+        trace_id: str,
+        span_id: Optional[str],
+        links: Sequence[Mapping[str, Any]],
+    ) -> None:
+        """Append cross-trace links to a stored span (the serving batch
+        job's root learns its riders only after the job is submitted, so
+        links land post-``open``). No-op when the span is absent."""
+        if span_id is None or not links:
+            return
+        with self._lock:
+            span = self._traces.get(trace_id, {}).get(span_id)
+            if span is None:
+                return
+            span.setdefault("links", []).extend(dict(link) for link in links)
+
+    def links(self, trace_id: str, span_id: str) -> List[Dict[str, Any]]:
+        """The stored links of one span (empty when absent/link-free)."""
+        with self._lock:
+            span = self._traces.get(trace_id, {}).get(span_id)
+            return [dict(link) for link in span.get("links", [])] \
+                if span else []
 
     def finish(
         self,

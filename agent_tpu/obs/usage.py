@@ -343,11 +343,16 @@ class UsageLedger:
             "totals": {
                 **_rounded(totals),
                 "est_cost": self._cost(totals["chip_seconds"]),
+                "prefix_dedupe_ratio": _dedupe_ratio(totals),
             },
             "by_tenant": {
                 tenant: {
                     **_rounded(t),
                     "est_cost": self._cost(t["chip_seconds"]),
+                    # What fraction of this tenant's prefill rows the prefix
+                    # cache absorbed (ISSUE 17 satellite): cache_hit_rows
+                    # was billed all along but never surfaced as a rate.
+                    "prefix_dedupe_ratio": _dedupe_ratio(t),
                     "by_op": {
                         op: _rounded(b) for op, b in sorted(t["by_op"].items())
                     },
@@ -375,6 +380,18 @@ class UsageLedger:
                 t: int(n) for t, n in sorted(pending_by_tenant.items())
             }
         return out
+
+
+def _dedupe_ratio(bucket: Mapping[str, Any]) -> Optional[float]:
+    """cache_hit_rows / (rows + cache_hit_rows) — the share of prefill
+    demand the prefix cache deduplicated away. None when no rows billed
+    yet (0/0 is "no data", not "no dedupe")."""
+    hits = float(bucket.get("cache_hit_rows", 0) or 0)
+    rows = float(bucket.get("rows", 0) or 0)
+    denom = rows + hits
+    if denom <= 0:
+        return None
+    return round(hits / denom, 4)
 
 
 def stamp_usage(tags: Optional[Dict[str, Any]], **fields: float) -> None:

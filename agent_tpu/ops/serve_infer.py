@@ -107,6 +107,7 @@ def _validate_requests(payload: Dict[str, Any]):
 def run_classify(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
     """Batched interactive classify: requests in, per-request top-k out."""
     t0 = time.perf_counter()
+    t0_wall = time.time()
     if not isinstance(payload, dict):
         return bad_input("payload must be a dict")
     try:
@@ -149,6 +150,21 @@ def run_classify(payload: Any, ctx: Optional[object] = None) -> Dict[str, Any]:
             # No decode stream: the first answer byte IS the whole answer.
             "ttft_ms": _clamp_ttft(now, r.get("arrived_wall")),
             "tokens": 0,
+            # Per-request telemetry (ISSUE 17): classify is one forward —
+            # the whole device window is "prefill", first token == done.
+            "telemetry": {
+                "path": "colocated",
+                "prefill_t0_wall": t0_wall,
+                "prefill_t1_wall": now,
+                "admitted_wall": now,
+                "joined_wall": now,
+                "first_token_wall": now,
+                "done_wall": now,
+                "kv_wait_ms": 0.0,
+                "occupancy_at_join": len(reqs),
+                "cache_hit": False,
+                "steps": 0,
+            },
         }
         for i, r in enumerate(reqs)
     ]
@@ -369,6 +385,7 @@ def _prefill_rows(runtime, params, state, serve):
                 hit[i] = True
     miss = np.nonzero(~hit)[0]
     ev0 = cache.evictions if cache is not None else 0
+    t_pf0 = time.time()
     if miss.size:
 
         def build(Ls=Ls, n=int(miss.size)):
@@ -406,6 +423,12 @@ def _prefill_rows(runtime, params, state, serve):
         "evictions": int(
             (cache.evictions - ev0) if cache is not None else 0
         ),
+        # Per-row hit flags + the encoder-forward wall window (ISSUE 17):
+        # the telemetry side channel — finalize pops them out of the
+        # controller-visible prefix counters.
+        "row_hits": hit.tolist(),
+        "prefill_t0_wall": t_pf0,
+        "prefill_t1_wall": time.time(),
     }
 
 
@@ -504,8 +527,19 @@ def finalize(executed: Dict[str, Any], ctx: Optional[object] = None
 
     state = executed["state"]
     tok = ByteTokenizer()
+    prefix = dict(executed.get("prefix") or {
+        "hits": 0, "misses": 0, "evictions": 0,
+    })
+    # Telemetry side channel riding the prefix dict (ISSUE 17): per-row
+    # cache-hit flags + the prefill wall window — popped here so the
+    # controller-facing prefix counters stay {hits, misses, evictions}.
+    row_hits = prefix.pop("row_hits", None)
+    pf_t0 = prefix.pop("prefill_t0_wall", None)
+    pf_t1 = prefix.pop("prefill_t1_wall", None)
+    path = "disagg" if state.get("op_name") == "serve_decode" \
+        else "colocated"
     results: List[Dict[str, Any]] = []
-    for ticket in executed["tickets"]:
+    for i, ticket in enumerate(executed["tickets"]):
         row = ticket.tokens if ticket.tokens is not None else np.array([], int)
         results.append({
             "req_id": ticket.data["req_id"],
@@ -515,6 +549,31 @@ def finalize(executed: Dict[str, Any], ctx: Optional[object] = None
             "ttft_ms": _clamp_ttft(
                 ticket.first_token_wall, ticket.data.get("arrived_wall")
             ),
+            # Raw decomposition material for the controller's
+            # serve_ttft_component_seconds / serve_tpot_seconds feeds and
+            # the synthesized request-trace spans: lifecycle walls stamped
+            # by the continuous engine + the prefill window above. Walls
+            # on either side of a process boundary telescope — the
+            # component sum equals first_token − arrival exactly.
+            "telemetry": {
+                "path": path,
+                "prefill_t0_wall": pf_t0,
+                "prefill_t1_wall": pf_t1,
+                "admitted_wall": ticket.admitted_wall,
+                "joined_wall": ticket.joined_wall,
+                "first_token_wall": ticket.first_token_wall,
+                "done_wall": ticket.done_wall,
+                "kv_wait_ms": round(ticket.kv_wait_s * 1e3, 3),
+                "join_step": int(ticket.join_step),
+                "occupancy_at_join": int(ticket.occupancy_at_join),
+                "cache_hit": bool(row_hits[i]) if (
+                    isinstance(row_hits, list) and i < len(row_hits)
+                ) else False,
+                "steps": int(ticket.steps),
+                "events": [
+                    [name, wall] for name, wall in ticket.events
+                ],
+            },
         })
     if ctx is not None and hasattr(ctx, "tags"):
         ctx.tags.setdefault("timings", {}).update(
@@ -526,9 +585,6 @@ def finalize(executed: Dict[str, Any], ctx: Optional[object] = None
     from agent_tpu.ops._model_common import stamp_rows
 
     stamp_rows(ctx, len(results))
-    prefix = dict(executed.get("prefix") or {
-        "hits": 0, "misses": 0, "evictions": 0,
-    })
     # A disaggregated decode job carries the PREFILL agent's counters
     # forward (so the controller's reap sees them on the one job it
     # watches) — but that agent already billed the cache hits; billing

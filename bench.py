@@ -1517,6 +1517,39 @@ def _bench_serving_beam(runtime):
     }
 
 
+def _audit_ttft_decomposition(controller):
+    """TTFT decomposition audit shared by the serving legs (ISSUE 17):
+    every completed record in the wide-event request log whose component
+    chain is whole must telescope back to its measured TTFT within 10% —
+    drift means the component histograms misattribute where time went.
+    Returns ``(n_records, max_err, modal dominant component)``."""
+    recs = [
+        r for r in controller.requests_json(limit=2048)["requests"]
+        if r.get("outcome") == "completed"
+        and isinstance(r.get("ttft_ms"), (int, float))
+        and r["ttft_ms"] > 0
+        and len(r.get("components") or {}) == 6
+    ]
+    errs = [
+        abs(sum(r["components"].values()) - r["ttft_ms"]) / r["ttft_ms"]
+        for r in recs
+    ]
+    assert not errs or max(errs) <= 0.10, (
+        f"TTFT components drifted {max(errs):.1%} from measured TTFT "
+        f"(tolerance 10%)"
+    )
+    dom_counts: dict = {}
+    for r in recs:
+        d = r.get("dominant_component")
+        if d:
+            dom_counts[d] = dom_counts.get(d, 0) + 1
+    return (
+        len(recs),
+        round(max(errs), 4) if errs else None,
+        max(dom_counts, key=dom_counts.get) if dom_counts else None,
+    )
+
+
 def _bench_serving_disagg(runtime):
     """``serving.disagg`` sub-leg (ISSUE 16): the SAME seeded prefix-heavy
     greedy summarize stream driven through two in-process controller
@@ -1677,8 +1710,12 @@ def _bench_serving_disagg(runtime):
         hits = controller._m_serve_prefix.value(event="hits") - hits0
         misses = controller._m_serve_prefix.value(event="misses") - miss0
         looked = hits + misses
+        n_dec, max_err, dominant = _audit_ttft_decomposition(controller)
         out = {
             "requests": len(snaps),
+            "ttft_decomposed_requests": n_dec,
+            "ttft_decomposition_max_err": max_err,
+            "ttft_dominant_component": dominant,
             "bulk_rows": SERVE_DISAGG_BULK_ROWS,
             "window_s": round(wall, 2),
             "tok_per_sec": round(tokens / wall, 1) if wall else None,
@@ -1864,6 +1901,7 @@ def _bench_serving(runtime):
             from agent_tpu.obs.scrape import fetch_health
 
             health = fetch_health(server.url)
+            n_dec, max_err, dominant = _audit_ttft_decomposition(controller)
             leg.update(
                 requests=len(snaps),
                 rejected=stats.total_rejected(),
@@ -1875,6 +1913,9 @@ def _bench_serving(runtime):
                 ) if ttfts else None,
                 tok_per_sec=round(tokens / window, 1) if window else None,
                 health_verdict=(health or {}).get("verdict"),
+                ttft_decomposed_requests=n_dec,
+                ttft_decomposition_max_err=max_err,
+                ttft_dominant_component=dominant,
             )
         agent.running = False
         rt.join(timeout=60)
@@ -2160,6 +2201,14 @@ def main() -> int:
                 "serving_beam_speedup_vs_static": (
                     legs["serving"].get("beam") or {}
                 ).get("speedup_vs_static"),
+                # Request-level observability flat fields (ISSUE 17): the
+                # modal dominant TTFT component across the leg's completed
+                # requests (a string — the regression judge skips it) and
+                # the worst component-sum drift vs measured TTFT.
+                "serving_ttft_dominant_component": legs["serving"]
+                .get("ttft_dominant_component"),
+                "serving_ttft_decomposition_max_err": legs["serving"]
+                .get("ttft_decomposition_max_err"),
                 # Disaggregated serving flat fields (ISSUE 16): the
                 # prefix-heavy mix through the paged-KV + prefix-cache +
                 # prefill/decode-split stack, vs the colocated cold

@@ -177,6 +177,35 @@ def serving_summary(metrics_text, status):
     return out
 
 
+def request_tail(base: str, limit: int = 5):
+    """The per-request tail panel's feed (ISSUE 17): the slowest/error
+    records the wide-event request log kept, newest first, each with its
+    dominant TTFT component ("why was THIS request slow"). None when the
+    controller predates the log or serving is off."""
+    body = fetch_json(
+        base + f"/v1/debug/requests?slow=1&limit={int(limit)}"
+    )
+    if not isinstance(body, dict) or not body.get("enabled"):
+        return None
+    out = []
+    for rec in body.get("requests") or []:
+        comps = rec.get("components") or {}
+        dom = rec.get("dominant_component")
+        out.append({
+            "req_id": rec.get("req_id"),
+            "tenant": rec.get("tenant"),
+            "op": rec.get("op"),
+            "outcome": rec.get("outcome"),
+            "path": rec.get("path"),
+            "ttft_ms": rec.get("ttft_ms"),
+            "tpot_ms": rec.get("tpot_ms"),
+            "dominant_component": dom,
+            "dominant_ms": comps.get(dom),
+            "kept": rec.get("kept"),
+        })
+    return out
+
+
 def tasks_total(metrics_text) -> float:
     """Fleet-wide completed tasks off the exposition (unlabeled merge only —
     ``agent``-labeled duplicates would double-count). The scrape-delta
@@ -254,7 +283,7 @@ def last_value(points):
 
 
 def render(health, status, rate, colors: Colors, trends=None,
-           serving=None) -> str:
+           serving=None, req_tail=None) -> str:
     lines = []
     verdict = health.get("verdict", "?")
     now = time.strftime("%H:%M:%S")
@@ -357,6 +386,30 @@ def render(health, status, rate, colors: Colors, trends=None,
                 f"  kv pool: {kv_s}"
             )
         lines.append(colors.paint(f"  requests: {req_s}", DIM))
+        if req_tail:
+            # Per-request tail (ISSUE 17): the slowest/error records the
+            # wide-event log kept, each blamed on its dominant TTFT
+            # component — request-level "why", not another aggregate.
+            lines.append(colors.paint(
+                f"  {'slow requests':<18}{'op':<11}{'outcome':<11}"
+                f"{'ttft ms':>9}  {'dominant component':<22}", DIM))
+            for rec in req_tail:
+                dom = rec.get("dominant_component") or "-"
+                dom_ms = rec.get("dominant_ms")
+                dom_s = (
+                    f"{dom} ({fmt_num(dom_ms, 1)}ms)"
+                    if dom_ms is not None else dom
+                )
+                line = (
+                    f"  {str(rec.get('req_id'))[:17]:<18}"
+                    f"{str(rec.get('op'))[:10]:<11}"
+                    f"{str(rec.get('outcome'))[:10]:<11}"
+                    f"{fmt_num(rec.get('ttft_ms'), 1):>9}  "
+                    f"{dom_s:<22}"
+                )
+                if rec.get("outcome") != "completed":
+                    line = colors.paint(line, FG["warn"])
+                lines.append(line)
         lines.append("")
 
     q = health.get("queue", {})
@@ -457,6 +510,7 @@ def main() -> int:
         trends = collect_trends(base)
         metrics_text = fetch_text(base + "/v1/metrics")
         serving = serving_summary(metrics_text, status)
+        req_tail = request_tail(base) if serving is not None else None
         if args.json:
             # One-shot scripting mode (ISSUE 9 satellite): everything the
             # dashboard renders, as one JSON doc on stdout.
@@ -468,6 +522,7 @@ def main() -> int:
                 "usage": fetch_json(base + "/v1/usage"),
                 "trends": trends,
                 "serving": serving,
+                "request_tail": req_tail,
                 "rates": {
                     "tasks_per_sec": last_value(trends["tasks_per_sec"]),
                     "rows_per_sec": last_value(trends["rows_per_sec"]),
@@ -489,7 +544,7 @@ def main() -> int:
                 rate = max(0.0, (total - prev_tasks) / (now - prev_t))
             prev_tasks, prev_t = total, now
         frame = render(health, status, rate, colors, trends=trends,
-                       serving=serving)
+                       serving=serving, req_tail=req_tail)
         if args.once:
             sys.stdout.write(frame)
             return 0

@@ -1,0 +1,131 @@
+"""Wide-event request log (ISSUE 17): tail-based sampling (errors + the
+slow decile always kept), ring bounding, deterministic hash sampling,
+snapshot filters, and the dominant-component helper."""
+
+from __future__ import annotations
+
+from agent_tpu.obs.reqlog import (
+    SLOW_MIN_SAMPLES,
+    RequestLog,
+    _sample_fraction,
+    dominant_component,
+)
+
+
+def _rec(i, ttft=10.0, outcome="completed", tenant="default"):
+    return {
+        "req_id": f"req-{i:08d}",
+        "tenant": tenant,
+        "outcome": outcome,
+        "ttft_ms": ttft,
+    }
+
+
+class TestTailSampling:
+    def test_errors_always_kept_even_at_sample_zero(self):
+        log = RequestLog(sample=0.0)
+        # Enough healthy traffic to get past the warmup keep-everything
+        # phase and establish a slow-decile threshold.
+        for i in range(200):
+            log.add(_rec(i, ttft=10.0 + (i % 7)))
+        kept_before = log.kept
+        for i in range(200, 220):
+            reason = log.add(_rec(i, ttft=1.0, outcome="failed"))
+            assert reason == "error"
+        assert log.kept == kept_before + 20
+        errors = [r for r in log.snapshot() if r["outcome"] == "failed"]
+        assert len(errors) == 20
+
+    def test_slow_decile_kept_at_sample_zero(self):
+        log = RequestLog(sample=0.0)
+        for i in range(300):
+            # 10% of traffic is 100x slower — exactly the tail the log
+            # must retain when healthy sampling is off.
+            slow = i % 10 == 0
+            log.add(_rec(i, ttft=1000.0 if slow else 10.0))
+        recs = log.snapshot(limit=1000)
+        slow_kept = [r for r in recs if r["ttft_ms"] == 1000.0]
+        assert slow_kept, "slow decile entirely sampled out"
+        # Past warmup, fast/healthy records only survive via sampling —
+        # which is off.
+        fast_kept = [
+            r for r in recs
+            if r["ttft_ms"] < 100.0 and r["kept"] == "sampled"
+        ]
+        assert not fast_kept
+
+    def test_warmup_keeps_everything(self):
+        log = RequestLog(sample=0.0)
+        for i in range(SLOW_MIN_SAMPLES - 1):
+            assert log.add(_rec(i)) is not None
+
+    def test_sample_one_keeps_everything(self):
+        log = RequestLog(sample=1.0)
+        for i in range(100):
+            assert log.add(_rec(i)) is not None
+        assert log.sampled_out == 0
+
+    def test_sampling_is_deterministic_per_req_id(self):
+        assert _sample_fraction("req-abc") == _sample_fraction("req-abc")
+        log1, log2 = RequestLog(sample=0.5), RequestLog(sample=0.5)
+        for i in range(300):
+            # Varied TTFTs: most records land below the slow decile, so
+            # their fate rests on the req_id hash coin alone.
+            log1.add(_rec(i, ttft=10.0 + (i % 10)))
+            log2.add(_rec(i, ttft=10.0 + (i % 10)))
+        ids = lambda log: [r["req_id"] for r in log.snapshot(limit=1000)]  # noqa: E731
+        assert ids(log1) == ids(log2)
+        assert log1.sampled_out > 0  # the coin actually flips at 0.5
+
+    def test_keep_reason_annotated(self):
+        log = RequestLog(sample=1.0)
+        log.add(_rec(0))
+        (rec,) = log.snapshot()
+        assert rec["kept"] in ("slow", "sampled")
+        assert "ts" in rec
+
+
+class TestRingAndFilters:
+    def test_ring_bounded(self):
+        log = RequestLog(capacity=16, sample=1.0)
+        for i in range(100):
+            log.add(_rec(i))
+        assert len(log) == 16
+        newest = log.snapshot(limit=1)[0]
+        assert newest["req_id"] == "req-00000099"  # newest-first
+
+    def test_filters(self):
+        log = RequestLog(sample=1.0)
+        log.add(_rec(0, tenant="acme"))
+        log.add(_rec(1, tenant="beta"))
+        log.add(_rec(2, tenant="acme", outcome="failed"))
+        assert {
+            r["req_id"] for r in log.snapshot(tenant="acme")
+        } == {"req-00000000", "req-00000002"}
+        assert [
+            r["req_id"] for r in log.snapshot(outcome="failed")
+        ] == ["req-00000002"]
+        slow_only = log.snapshot(slow=True)
+        assert all(r["kept"] in ("error", "slow") for r in slow_only)
+        assert len(log.snapshot(limit=2)) == 2
+
+    def test_stats(self):
+        log = RequestLog(capacity=8, sample=1.0)
+        for i in range(5):
+            log.add(_rec(i))
+        s = log.stats()
+        assert s["seen"] == 5 and s["kept"] == 5 and s["size"] == 5
+        assert s["capacity"] == 8 and s["sample"] == 1.0
+        assert sum(s["kept_by_reason"].values()) == 5
+
+
+class TestDominantComponent:
+    def test_picks_largest(self):
+        assert dominant_component(
+            {"bucket_wait": 1.0, "prefill": 40.0, "kv_wait": 2.0}
+        ) == "prefill"
+
+    def test_empty_and_garbage(self):
+        assert dominant_component({}) is None
+        assert dominant_component(None) is None
+        assert dominant_component({"a": "nan?", "b": 1.0}) == "b"

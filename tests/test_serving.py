@@ -554,3 +554,183 @@ class TestInferHttp:
         server, session = http_server
         st = session.get(server.url + "/v1/status", timeout=10).json()
         assert st["serving"]["enabled"] is True
+
+
+# ---------------------------------------------------------------------------
+# request-level observability (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _hist_count(controller, family, **labels):
+    for s in controller.metrics.snapshot().get(family, {}).get("series", []):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s["count"]
+    return 0
+
+
+class TestRequestObservability:
+    def test_flush_reasons_counted_and_bucket_wait_component_fed(self):
+        """ISSUE 17 satellite: full and deadline flushes count distinctly
+        in serve_batches_total, and BOTH paths feed the bucket_wait
+        component histogram once their riders complete."""
+        c = Controller(serve=ServeConfig(max_wait_ms=0.0, max_batch=2))
+        # Same bucket twice -> the second submit fills it: reason "full".
+        for text in ("classify this", "classify that!"):
+            c.submit_infer("classify", text,
+                           params={"model_config": TINY_CLS})
+        assert c._m_serve_batches.value(op="classify", reason="full") == 1
+        # A lone rider flushes on the pump cadence: reason "deadline".
+        c.submit_infer("classify", "straggler text",
+                       params={"model_config": TINY_CLS})
+        c._serve_pump()
+        assert c._m_serve_batches.value(
+            op="classify", reason="deadline"
+        ) == 1
+        _drain_serving(c)
+        c._serve_reap()
+        assert _hist_count(
+            c, "serve_ttft_component_seconds", component="bucket_wait"
+        ) == 3
+        reasons = {
+            r["flush_reason"] for r in c.requests_json()["requests"]
+        }
+        assert reasons == {"full", "deadline"}
+
+    def test_stitched_request_trace_and_wide_record(self):
+        """Tentpole acceptance (colocated): a completed request resolves to
+        ONE complete trace linked into its batch job, its TTFT components
+        telescope to the measured TTFT, and the wide-event record carries
+        the full schema."""
+        c = Controller(serve=ServeConfig(max_wait_ms=0.0, max_batch=4))
+        rid = c.submit_infer(
+            "summarize", "summarize this text",
+            params={"model_config": TINY_S2S, "max_length": 5},
+        )
+        c._serve_pump()
+        _drain_serving(c)
+        c._serve_reap()
+        (rec,) = c.requests_json()["requests"]
+        assert rec["req_id"] == rid and rec["outcome"] == "completed"
+        assert rec["path"] == "colocated"
+        for key in ("tenant", "op", "bucket", "priority", "ttft_ms",
+                    "tpot_ms", "tokens", "steps", "prefix_hit",
+                    "kv_wait_ms", "occupancy", "components",
+                    "dominant_component", "trace_id", "job_id"):
+            assert key in rec, key
+        comps = rec["components"]
+        assert set(comps) == {"bucket_wait", "queue_wait", "prefill",
+                              "handoff", "kv_wait", "first_decode"}
+        assert abs(sum(comps.values()) - rec["ttft_ms"]) <= \
+            max(1.0, 0.1 * rec["ttft_ms"])
+        tr = c.trace_json(rid)
+        assert tr is not None and tr["complete"], tr
+        names = {s["name"] for s in tr["spans"]}
+        assert {"infer", "bucket.wait", "ttft.first_decode",
+                "decode"} <= names
+        linked = {lt["trace_id"] for lt in tr.get("linked_traces", [])}
+        assert rec["job_id"] in linked
+        # And the batch job's trace names this rider back.
+        job_tr = c.trace_json(rec["job_id"])
+        assert rid in {
+            lt["trace_id"] for lt in job_tr.get("linked_traces", [])
+        }
+
+    def test_disagg_dep_failed_emits_dep_failed_record(self):
+        """ISSUE 17 satellite: riders of a serve_decode job killed by a
+        dead prefill dependency get outcome=dep_failed in the request
+        log (not a generic failure)."""
+        c = Controller(serve=ServeConfig(
+            max_wait_ms=0.0, max_batch=4, disaggregated=True,
+        ))
+        rid = c.submit_infer(
+            "summarize", "doomed request",
+            params={"model_config": TINY_S2S, "max_length": 4},
+        )
+        c._serve_pump()
+        # The front door watches the DECODE job; its dependency is the
+        # prefill leg. Fail that leg to death: permanent error, retries
+        # exhausted.
+        (decode_id,) = c.serve_door.job_ids()
+        (pf_id,) = c.job(decode_id).after
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if c.job(pf_id).state in ("failed", "dead"):
+                break
+            lease = c.lease(
+                agent="t", capabilities={"ops": ["serve_prefill"]},
+                max_tasks=1,
+            )
+            if lease is None:
+                time.sleep(0.005)
+                continue
+            for task in lease["tasks"]:
+                c.report(
+                    lease_id=lease["lease_id"], job_id=task["id"],
+                    job_epoch=task["job_epoch"], status="failed",
+                    error={"type": "ValueError", "message": "boom"},
+                )
+        c._serve_pump()
+        snap = c.infer_snapshot(rid)
+        assert snap["state"] == "failed", snap
+        (rec,) = c.requests_json()["requests"]
+        assert rec["outcome"] == "dep_failed", rec
+        assert rec["error"] == "DependencyFailed"
+        # The request's root span closed with the verdict.
+        tr = c.trace_json(rid)
+        root = next(s for s in tr["spans"] if s["name"] == "infer")
+        assert root["attributes"]["outcome"] == "dep_failed"
+        assert root["duration_ms"] is not None
+
+    def test_debug_requests_http_filters_and_jsonl(self, http_server):
+        """GET /v1/debug/requests: tenant/outcome/slow filters + JSONL
+        export; GET /v1/debug/events?req_id= narrows to one request."""
+        import json as _json
+
+        server, session = http_server
+        r = session.post(server.url + "/v1/infer", json={
+            "op": "classify", "text": "observable request",
+            "tenant": "acme", "params": {"model_config": TINY_CLS},
+        }, timeout=120)
+        assert r.json()["state"] == "done", r.json()
+        rid = r.json()["req_id"]
+        body = session.get(
+            server.url + "/v1/debug/requests?tenant=acme", timeout=10
+        ).json()
+        assert body["enabled"] and body["requests"]
+        assert all(rec["tenant"] == "acme" for rec in body["requests"])
+        assert body["stats"]["seen"] >= 1
+        none = session.get(
+            server.url + "/v1/debug/requests?tenant=nobody", timeout=10
+        ).json()
+        assert none["requests"] == []
+        jl = session.get(
+            server.url + "/v1/debug/requests?format=jsonl", timeout=10
+        )
+        assert jl.headers["Content-Type"].startswith("application/jsonl")
+        recs = [_json.loads(line) for line in jl.text.splitlines() if line]
+        assert any(rec["req_id"] == rid for rec in recs)
+        ev = session.get(
+            server.url + f"/v1/debug/events?req_id={rid}", timeout=10
+        ).json()["events"]
+        assert ev and all(e.get("req_id") == rid for e in ev)
+        # The stitched trace resolves over HTTP for a req_id too.
+        tr = session.get(
+            server.url + f"/v1/trace/{rid}", timeout=10
+        ).json()
+        assert tr["trace_id"] == rid and tr.get("linked_traces")
+
+    def test_usage_surfaces_prefix_dedupe_ratio(self):
+        """ISSUE 17 satellite: /v1/usage exposes the per-tenant share of
+        prefill rows the prefix cache absorbed."""
+        from agent_tpu.obs.usage import UsageLedger
+
+        ledger = UsageLedger()
+        ledger.bill(
+            job_id="j1", tenant="acme", tier=5, op="serve_summarize",
+            attempt=1,
+            usage={"device_s": 1.0, "rows": 6, "cache_hit_rows": 2},
+        )
+        report = ledger.report()
+        assert report["by_tenant"]["acme"]["prefix_dedupe_ratio"] == \
+            pytest.approx(0.25)
+        assert report["totals"]["prefix_dedupe_ratio"] == \
+            pytest.approx(0.25)

@@ -151,6 +151,67 @@ class TestTraceStore:
         assert not store.add(_span())
 
 
+class TestSpanLinks:
+    """Cross-trace span links (ISSUE 17): additive-only — a link-free span
+    serializes byte-identically to the pre-links schema, and links never
+    participate in parent/child assembly."""
+
+    def test_link_free_wire_bytes_unchanged(self):
+        wire = make_span("x", "t1", start_mono=0.0, duration_s=0.001)
+        assert "links" not in wire
+        assert "links" not in obs_trace.Span(
+            trace_id="t1", span_id="s1", name="x"
+        ).to_wire()
+
+    def test_make_span_emits_links(self):
+        link = obs_trace.span_link("other-trace", "s9", kind="serve_request")
+        assert link == {
+            "trace_id": "other-trace", "span_id": "s9",
+            "attributes": {"kind": "serve_request"},
+        }
+        wire = make_span("x", "t1", start_mono=0.0, duration_s=0.001,
+                         links=[link])
+        assert wire["links"] == [link]
+
+    def test_span_link_omits_empty_fields(self):
+        assert obs_trace.span_link("t2") == {"trace_id": "t2"}
+
+    def test_store_add_links_post_open_and_read_back(self):
+        store = TraceStore()
+        root = store.open("job-1", "submit", start_clock=0.0)
+        assert store.links("job-1", root) == []
+        store.add_links("job-1", root, [obs_trace.span_link("req-a", "s1")])
+        store.add_links("job-1", root, [obs_trace.span_link("req-b")])
+        assert store.links("job-1", root) == [
+            {"trace_id": "req-a", "span_id": "s1"},
+            {"trace_id": "req-b"},
+        ]
+        # Absent span / trace: silent no-op, empty read.
+        store.add_links("job-1", "nope", [obs_trace.span_link("x")])
+        store.add_links("no-trace", root, [obs_trace.span_link("x")])
+        assert store.links("no-trace", root) == []
+
+    def test_links_do_not_affect_assembly(self):
+        store = TraceStore()
+        root = store.open("t1", "root", start_clock=0.0)
+        store.add_links(
+            "t1", root, [obs_trace.span_link("elsewhere", "dangling")]
+        )
+        store.finish("t1", root, 1.0)
+        out = store.assemble("t1")
+        assert out["complete"] and not out["orphans"]
+        (span,) = out["spans"]
+        assert span["links"] == [
+            {"trace_id": "elsewhere", "span_id": "dangling"}
+        ]
+
+    def test_links_survive_jsonl_round_trip(self):
+        wire = make_span("x", "t1", start_mono=0.0, duration_s=0.001,
+                         links=[obs_trace.span_link("t2", "s2")])
+        (back,) = from_jsonl(to_jsonl([wire]))
+        assert back["links"] == [{"trace_id": "t2", "span_id": "s2"}]
+
+
 class TestExporters:
     def test_jsonl_round_trip(self):
         spans = [_span(span_id="a"), _span(span_id="b", parent="a")]
