@@ -300,6 +300,40 @@ class Agent:
             urls.insert(0, a.controller_url)
         self._controller_urls = urls
         self._url_index = 0
+        # Partitioned control plane (ISSUE 18): with an explicit partition
+        # map the agent wraps its session in the in-process router shim —
+        # home-first leases, depth-based stealing, tagged lease ids — and
+        # the whole loop above this line stays topology-blind. The spool
+        # stores the TAGGED lease id, so redelivery follows the stolen
+        # job's applying partition through the shim with no new spool
+        # machinery. (With a router URL in CONTROLLER_URLS the router does
+        # all of this server-side and this branch never runs.)
+        if a.controller_partition_map:
+            from agent_tpu.controller.partition import (
+                PartitionMap,
+                PartitionSession,
+            )
+            from agent_tpu.sched.steal import StealPolicy
+
+            pmap = PartitionMap.parse(a.controller_partition_map)
+            steal = StealPolicy.from_env()
+            self.session = PartitionSession(
+                self.session, pmap, steal=steal,
+                timeout_sec=a.http_timeout_sec,
+            )
+            # The pipelined poster thread builds its own session
+            # (requests.Session is not thread-safe) — give it the same
+            # shim, or results would bypass the partition map entirely.
+            if getattr(self, "post_session_factory", None) is None:
+                def _partition_post_session() -> PartitionSession:
+                    import requests
+
+                    return PartitionSession(
+                        requests.Session(), pmap, steal=steal,
+                        timeout_sec=a.http_timeout_sec,
+                    )
+
+                self.post_session_factory = _partition_post_session
 
     # ---- controller I/O ----
 

@@ -101,6 +101,13 @@ class AgentConfig:
     # — how spooled results redeliver to a promoted hot standby instead of
     # waiting out a dead primary. Empty = just controller_url.
     controller_urls: Tuple[str, ...] = ()
+    # Partitioned control plane (ISSUE 18): an EXPLICIT partition map
+    # ("p0=http://a|http://a-standby,p1=http://b") makes the agent run the
+    # router's placement/steal/result-routing logic in-process instead of
+    # needing a router hop — CONTROLLER_URLS generalizes to either a
+    # router URL (leave this empty) or this map. See
+    # controller/partition.PartitionSession.
+    controller_partition_map: str = ""
     agent_name: str = field(default_factory=socket.gethostname)
     http_timeout_sec: float = 10.0
     idle_sleep_sec: float = 0.25
@@ -162,6 +169,9 @@ class AgentConfig:
                 "CONTROLLER_URL", urls[0] if urls else "http://10.11.12.54:8080"
             ).rstrip("/"),
             controller_urls=urls,
+            controller_partition_map=env_str(
+                "CONTROLLER_PARTITION_MAP", ""
+            ).strip(),
             agent_name=env_str("AGENT_NAME", socket.gethostname()),
             http_timeout_sec=env_float("HTTP_TIMEOUT_SEC", 10.0),
             idle_sleep_sec=env_float("IDLE_SLEEP_SEC", 0.25),
@@ -744,6 +754,43 @@ class OpsConfig:
 
 
 @dataclass(frozen=True)
+class PartitionConfig:
+    """Partitioned control plane knobs (ISSUE 18 — PARTITIONS/ROUTER_*).
+
+    The router process (``python -m agent_tpu.controller.router``) fronts
+    either an EXISTING fleet of partition controllers (``partition_urls``
+    names them, ``|``-separated alternates per partition for the hot
+    standby's slot) or, when only ``partitions`` is set, N in-process
+    partitions it boots itself — the single-host convenience mode.
+    The steal decision's own knobs (STEAL_ENABLED / STEAL_MIN_ADVANTAGE)
+    live with the policy in ``sched/steal.py``.
+    """
+
+    partitions: int = 0                   # PARTITIONS (0 = unpartitioned)
+    partition_urls: str = ""              # PARTITION_URLS ("p0=url|alt,p1=url")
+    router_host: str = "0.0.0.0"          # ROUTER_HOST
+    router_port: int = 8800               # ROUTER_PORT
+    # Steal-probe depth sample TTL: how stale the per-partition leasable
+    # depths the router steals against may be.
+    depth_cache_sec: float = 0.25         # ROUTER_DEPTH_CACHE_SEC
+    # Per-proxied-request upstream timeout.
+    timeout_sec: float = 30.0             # ROUTER_TIMEOUT_SEC
+
+    @staticmethod
+    def from_env() -> "PartitionConfig":
+        return PartitionConfig(
+            partitions=max(0, env_int("PARTITIONS", 0)),
+            partition_urls=env_str("PARTITION_URLS", "").strip(),
+            router_host=env_str("ROUTER_HOST", "0.0.0.0"),
+            router_port=env_int("ROUTER_PORT", 8800),
+            depth_cache_sec=max(
+                0.0, env_float("ROUTER_DEPTH_CACHE_SEC", 0.25)
+            ),
+            timeout_sec=max(0.1, env_float("ROUTER_TIMEOUT_SEC", 30.0)),
+        )
+
+
+@dataclass(frozen=True)
 class Config:
     """Aggregate, built once at process start and passed down explicitly."""
 
@@ -753,6 +800,7 @@ class Config:
     ops: OpsConfig = field(default_factory=OpsConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    partition: PartitionConfig = field(default_factory=PartitionConfig)
 
     @staticmethod
     def from_env() -> "Config":
@@ -763,4 +811,5 @@ class Config:
             ops=OpsConfig.from_env(),
             sched=SchedConfig.from_env(),
             serve=ServeConfig.from_env(),
+            partition=PartitionConfig.from_env(),
         )

@@ -231,8 +231,16 @@ class Controller:
         obs: Optional[ObsConfig] = None,
         journal: Optional[JournalConfig] = None,
         serve: Optional[ServeConfig] = None,
+        partition: Optional[str] = None,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
+        # Partitioned control plane (ISSUE 18): this controller's partition
+        # name, stamped into generated job/lease/request ids and the status
+        # surfaces so any id or status doc names its owning partition. None
+        # (the default) keeps every id byte-compatible with the
+        # single-controller shape.
+        self.partition = str(partition) if partition else None
+        self._id_tag = f"{self.partition}-" if self.partition else ""
         # Binary shard wire (ISSUE 6): False = never negotiate (a JSON-only
         # controller for compatibility tests and WIRE_BINARY=0 operators);
         # agents that don't advertise are unaffected either way.
@@ -478,7 +486,8 @@ class Controller:
                 sample=self.serve_config.reqlog_sample,
             )
             self.serve_door = ServeFrontDoor(
-                self.serve_config, clock=self._clock, traces=self.traces
+                self.serve_config, clock=self._clock, traces=self.traces,
+                partition=self.partition,
             )
         self.captures = CaptureCoordinator()
         # Built on first GET /v1/profile/host (a controller never asked for
@@ -678,6 +687,7 @@ class Controller:
             starvation_age_sec=max(ages) if ages else None,
             agents=agents,
             agent_stale_sec=self.slo_config.agent_stale_sec,
+            partition=self.partition,
         )
 
     @property
@@ -988,6 +998,8 @@ class Controller:
             "fsync": bool(file_stats.get("fsync")),
             "promotions": self.promotions,
         }
+        if self.partition:
+            out["partition"] = self.partition
         # Mirror the file-side numbers into gauges so the scrape surface
         # tracks them too (swarmtop, tsdb sparklines).
         if impl is not None:
@@ -1168,7 +1180,7 @@ class Controller:
         tenant: Optional[str] = None,
         deadline_sec: Optional[float] = None,
     ) -> str:
-        job_id = job_id or f"job-{uuid.uuid4().hex[:12]}"
+        job_id = job_id or f"job-{self._id_tag}{uuid.uuid4().hex[:12]}"
         if priority is not None:
             if (
                 isinstance(priority, bool)
@@ -1361,7 +1373,7 @@ class Controller:
                 self.submit(
                     map_op,
                     payload,
-                    job_id=f"shard-{i}-{uuid.uuid4().hex[:8]}",
+                    job_id=f"shard-{i}-{self._id_tag}{uuid.uuid4().hex[:8]}",
                     required_labels=required_labels,
                     max_attempts=max_attempts,
                     priority=priority,
@@ -1714,7 +1726,7 @@ class Controller:
                 plan is not None and plan.decide("stale_epoch")
             )
 
-            lease_id = f"lease-{uuid.uuid4().hex[:12]}"
+            lease_id = f"lease-{self._id_tag}{uuid.uuid4().hex[:12]}"
             now = self._clock()
             deadline = now + self.lease_ttl_sec
             tasks: List[Dict[str, Any]] = []
@@ -2709,6 +2721,29 @@ class Controller:
     def queue_depth(self) -> int:
         with self._lock:
             return self._sched.total()
+
+    def leasable_depth(self) -> int:
+        """Pending jobs an agent could lease RIGHT NOW — the number the
+        cross-partition steal probe reads off ``GET /v1/depth``
+        (ISSUE 18). Computed from job state, NOT the scheduler heap: the
+        heap deletes lazily, and a stale entry (a job completed via a
+        redelivered result while also requeued) would advertise phantom
+        depth — a steal victim with nothing to grant that can shadow a
+        partition with REAL work behind the min-advantage filter,
+        starving that job for as long as the phantom persists. O(jobs),
+        which the router's depth cache amortizes."""
+        with self._lock:
+            now = self._clock()
+            n = 0
+            for job in self._jobs.values():
+                if job.state != PENDING:
+                    continue
+                if job.not_before > now:
+                    continue
+                if job.after and not self._deps_done_locked(job):
+                    continue
+                n += 1
+            return n
 
     def agents_summary(self) -> Dict[str, Any]:
         """Per-agent liveness: seconds since the last lease poll plus the

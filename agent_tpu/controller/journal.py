@@ -254,15 +254,48 @@ class SegmentedJournal:
 
     # ---- append ----
 
+    @staticmethod
+    def _seal_torn_tail_at_open(path: str) -> int:
+        """Truncate a half-written final line before the first append.
+
+        Replay *tolerates* a dead incarnation's torn death write, but
+        appending after it would glue the next event onto the fragment —
+        turning a benign torn tail into mid-stream corruption (and losing
+        that next event) on every later replay. Promotion already seals via
+        ``StandbyTailer.seal()``; a plain restart over the same journal
+        (the partition-kill recovery path) must seal too. Returns the
+        bytes cut (0 = file was clean)."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return 0
+        if size == 0:
+            return 0
+        with open(path, "rb+") as f:
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) == b"\n":
+                return 0
+            f.seek(0)
+            data = f.read()
+            keep = data.rfind(b"\n") + 1
+            f.truncate(keep)
+            f.flush()
+            os.fsync(f.fileno())
+        cut = size - keep
+        log("sealed torn journal tail at restart", path=path, bytes=cut)
+        return cut
+
     def open_for_append(self) -> None:
         if self._file is not None:
             return
         if not self.segmented:
+            self._seal_torn_tail_at_open(self.path)
             self._file = open(self.path, "a", encoding="utf-8")
             return
         segments = list_segments(self.path)
         self._active_seq = segments[-1][0] if segments else 1
         active = segment_path(self.path, self._active_seq)
+        self._seal_torn_tail_at_open(active)
         self._file = open(active, "a", encoding="utf-8")
         self._active_bytes = self._file.tell()
         self._active_events = 0  # event budget counts THIS incarnation's

@@ -486,29 +486,46 @@ class _Handler(BaseHTTPRequestHandler):
             # 4) and scripts/swarmtop.py consume.
             self._send(200, self.controller.health_json())
             return
-        if self.path == "/v1/status":
+        if path == "/v1/depth":
+            # Partitioned control plane (ISSUE 18): the steal probe. A
+            # deliberately tiny payload the router polls per idle lease —
+            # /v1/status computes fleet merges and is far too heavy for
+            # that loop.
             self._send(
                 200,
                 {
-                    "counts": self.controller.counts(),
-                    "counts_by_op": self.controller.counts_by_op(),
+                    "partition": self.controller.partition,
                     "queue_depth": self.controller.queue_depth(),
-                    "drained": self.controller.drained(),
-                    "stale_results": self.controller.stale_results,
-                    "agents": self.controller.agents_summary(),
-                    "summary": self.controller.status_summary(),
-                    # Journal durability block (ISSUE 14 satellite): replay
-                    # damage (torn FINAL line vs mid-file corruption) plus
-                    # segment count/bytes, last-snapshot age, and the last
-                    # replay's duration — the O(live state) claim as a
-                    # number operators can read off one status call.
-                    "journal": self.controller.journal_status(),
-                    # Serving front-door block (ISSUE 15): request states,
-                    # open buckets, in-flight batch jobs, 429 drops.
-                    "serving": self.controller.serve_status(),
-                    "last_metrics": self.controller.last_metrics,
+                    "leasable": self.controller.leasable_depth(),
                 },
             )
+            return
+        if self.path == "/v1/status":
+            status_body = {
+                "counts": self.controller.counts(),
+                "counts_by_op": self.controller.counts_by_op(),
+                "queue_depth": self.controller.queue_depth(),
+                "drained": self.controller.drained(),
+                "stale_results": self.controller.stale_results,
+                "agents": self.controller.agents_summary(),
+                "summary": self.controller.status_summary(),
+                # Journal durability block (ISSUE 14 satellite): replay
+                # damage (torn FINAL line vs mid-file corruption) plus
+                # segment count/bytes, last-snapshot age, and the last
+                # replay's duration — the O(live state) claim as a
+                # number operators can read off one status call.
+                "journal": self.controller.journal_status(),
+                # Serving front-door block (ISSUE 15): request states,
+                # open buckets, in-flight batch jobs, 429 drops.
+                "serving": self.controller.serve_status(),
+                "last_metrics": self.controller.last_metrics,
+            }
+            # Partitioned mode only (ISSUE 18): the router's fan-out merge
+            # keys on this. A standalone controller's status schema stays
+            # byte-stable.
+            if self.controller.partition:
+                status_body["partition"] = self.controller.partition
+            self._send(200, status_body)
         elif self.path == "/v1/metrics":
             # Prometheus text exposition: controller series + fleet-merged
             # agent series + per-agent liveness (see Controller.metrics_text).
@@ -602,8 +619,13 @@ def main() -> int:
     ttl = env_float("LEASE_TTL_SEC", 30.0)
     journal = env_str("CONTROLLER_JOURNAL", "") or None
     sweep = env_float("CONTROLLER_SWEEP_SEC", 5.0)
+    # CONTROLLER_PARTITION (ISSUE 18): this process is one shard of a
+    # partitioned control plane — ids it generates carry the name and the
+    # router's fan-out merges key on it. Empty = standalone controller.
+    partition = env_str("CONTROLLER_PARTITION", "") or None
     sched = SchedConfig.from_env()
     controller = Controller(
+        partition=partition,
         lease_ttl_sec=ttl,
         journal_path=journal,
         sweep_interval_sec=sweep if sweep > 0 else None,

@@ -174,9 +174,16 @@ class ServeFrontDoor:
         config: Optional[ServeConfig] = None,
         clock=time.monotonic,
         traces=None,
+        partition=None,
     ) -> None:
         self.config = config if config is not None else ServeConfig()
         self._clock = clock
+        # Partitioned control plane (ISSUE 18): bucket keys already include
+        # the tenant, so the router sends a tenant's whole serve stream to
+        # one home partition and coalescing stays partition-local; the
+        # partition name rides the generated req ids (and /v1/status via
+        # stats()) so any req id names its owning partition.
+        self.partition = str(partition) if partition else None
         # Controller's TraceStore (ISSUE 17): each request opens its own
         # trace (trace_id = req_id) with an "infer" root and a
         # "bucket.wait" child closed at flush time. None = tracing off.
@@ -249,7 +256,10 @@ class ServeFrontDoor:
         sig = json.dumps(params, sort_keys=True)
         now_wall = time.time() if now_wall is None else now_wall
         req = InferRequest(
-            req_id=f"req-{uuid.uuid4().hex[:12]}",
+            req_id=(
+                f"req-{self.partition + '-' if self.partition else ''}"
+                f"{uuid.uuid4().hex[:12]}"
+            ),
             op=op,
             text=text,
             params=params,
@@ -498,7 +508,7 @@ class ServeFrontDoor:
             states: Dict[str, int] = {}
             for r in self._requests.values():
                 states[r.state] = states.get(r.state, 0) + 1
-            return {
+            out = {
                 "requests": states,
                 "open_buckets": len(self._buckets),
                 "bucketed": sum(
@@ -507,3 +517,6 @@ class ServeFrontDoor:
                 "jobs_in_flight": len(self._jobs),
                 "rejected": self.rejected,
             }
+            if self.partition:
+                out["partition"] = self.partition
+            return out

@@ -41,7 +41,14 @@ from agent_tpu.config import LoadgenConfig
 
 class Rejected(Exception):
     """Submit refused by admission control (HTTP 429) — the open loop
-    counts the drop and moves on."""
+    counts the drop and moves on. Behind the partitioned control plane's
+    router the 429 body names the rejecting partition (ISSUE 18);
+    ``partition`` carries it so drops count per partition, not as one
+    smeared fleet total."""
+
+    def __init__(self, msg: str, partition: Optional[str] = None) -> None:
+        super().__init__(msg)
+        self.partition = partition
 
 
 @dataclass(frozen=True)
@@ -155,6 +162,10 @@ class LoadGenStats:
 
     submitted: Dict[str, int] = field(default_factory=dict)
     rejected: Dict[str, int] = field(default_factory=dict)
+    # Which partition said no (ISSUE 18): keyed by the partition name the
+    # router stamped into the 429 body; unstamped rejects (a bare
+    # controller) count under "".
+    rejected_by_partition: Dict[str, int] = field(default_factory=dict)
     errors: Dict[str, int] = field(default_factory=dict)
     jobs: List[Dict[str, Any]] = field(default_factory=list)
 
@@ -241,8 +252,12 @@ class LoadGen:
             name = arrival.cls.name
             try:
                 job_id = submit(arrival)
-            except Rejected:
+            except Rejected as exc:
                 stats.rejected[name] = stats.rejected.get(name, 0) + 1
+                part = exc.partition or ""
+                stats.rejected_by_partition[part] = (
+                    stats.rejected_by_partition.get(part, 0) + 1
+                )
                 continue
             except Exception:  # noqa: BLE001 — open loop outlives blips
                 stats.errors[name] = stats.errors.get(name, 0) + 1
@@ -267,8 +282,11 @@ def session_submitter(
     (tenant/priority/deadline riding the body, job_id back);
     ``route="infer"`` classes POST to the serving front door
     ``{base_url}/v1/infer`` non-blocking (``wait: false``, req_id back) —
-    open loop both ways. 429 → :class:`Rejected` (open-loop drop); any
-    other non-200 raises."""
+    open loop both ways. 429 → :class:`Rejected` (open-loop drop,
+    carrying the rejecting partition when the body is router-stamped);
+    any other non-200 raises. ``base_url`` may be a single controller OR
+    the partition router (ISSUE 18) — the paths are identical, which is
+    the router's whole contract."""
     base = base_url.rstrip("/")
     jobs_url = f"{base}/v1/jobs"
     infer_url = f"{base}/v1/infer"
@@ -296,7 +314,16 @@ def session_submitter(
         resp = session.post(url, json=body, timeout=10.0)
         status = getattr(resp, "status_code", 0)
         if status == 429:
-            raise Rejected(f"admission rejected {cls.name!r}")
+            try:
+                rej = resp.json()
+            except ValueError:
+                rej = None
+            partition = (
+                rej.get("partition") if isinstance(rej, dict) else None
+            )
+            raise Rejected(
+                f"admission rejected {cls.name!r}", partition=partition
+            )
         if status != 200:
             raise RuntimeError(
                 f"submit {cls.name!r} failed: HTTP {status}"

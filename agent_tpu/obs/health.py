@@ -189,6 +189,7 @@ def build_health(
     agents: Optional[Mapping[str, Mapping[str, Any]]] = None,
     agent_stale_sec: float = DEFAULT_AGENT_STALE_SEC,
     now_wall: Optional[float] = None,
+    partition: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Assemble the ``GET /v1/health`` body. Pure: every input is data the
     controller already holds (SLO evaluations, job counts, scheduler depth,
@@ -234,7 +235,7 @@ def build_health(
         if verdict == "ok":
             verdict = "warn"
 
-    return {
+    out = {
         "verdict": verdict,
         "reasons": reasons,
         "generated_at": round(now_wall, 3),
@@ -260,3 +261,9 @@ def build_health(
         },
         "agents": agent_rows,
     }
+    if partition:
+        # Partitioned control plane (ISSUE 18): which shard of the control
+        # plane produced this verdict — the router's fan-out merge keys on
+        # it, and a single-partition reader sees where it is pointed.
+        out["partition"] = partition
+    return out

@@ -2013,7 +2013,10 @@ def main() -> int:
         )
         import controller_bench
 
-        ctrl = controller_bench.run_bench()
+        # partitions=3: the ISSUE 18 aggregate-submits leg — N partition
+        # processes journaling concurrently, the partitioned control
+        # plane's scaling claim as a tracked number.
+        ctrl = controller_bench.run_bench(partitions=3)
         legs["controller"] = {
             k: v for k, v in ctrl.items() if k != "detail"
         }
@@ -2068,6 +2071,8 @@ def main() -> int:
         ]
         if isinstance(legs.get("drain_multichip"), dict):
             legs["drain_multichip"]["starved"] = True
+    if host_cores < 4:  # 3 partition children + the bench parent
+        starved_fields.append("controller_agg_submits_per_sec")
 
     print(
         json.dumps(
@@ -2240,6 +2245,13 @@ def main() -> int:
                 .get("replay_compacted_sec"),
                 "controller_replay_speedup": legs["controller"]
                 .get("replay_speedup"),
+                # Partitioned aggregate (ISSUE 18): N concurrent
+                # partition processes vs one — starved-stamped on
+                # < 4-core hosts above.
+                "controller_agg_submits_per_sec": legs["controller"]
+                .get("agg_submits_per_sec"),
+                "controller_agg_speedup_vs_single": legs["controller"]
+                .get("agg_speedup_vs_single"),
             }
         ),
         flush=True,

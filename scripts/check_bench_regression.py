@@ -88,6 +88,11 @@ PER_FIELD_TOLERANCE = {
     "serving_disagg_tok_per_sec": 0.35,
     "serving_disagg_ttft_p99_ms": 0.35,
     "serving_disagg_vs_colocated": 0.25,
+    # Partitioned control plane (ISSUE 18): 3 concurrent journaling
+    # processes contend for disk + cores — wider band than the
+    # single-process controller legs.
+    "controller_agg_submits_per_sec": 0.25,
+    "controller_agg_speedup_vs_single": 0.25,
 }
 
 

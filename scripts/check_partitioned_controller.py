@@ -1,0 +1,558 @@
+#!/usr/bin/env python3
+"""Partitioned control-plane smoke — the ISSUE 18 acceptance drill,
+CI-shaped (< 90 s, CPU-only, real HTTP end to end).
+
+Three partition subprocesses (``python -m agent_tpu.controller.server``,
+each with its own segmented journal) behind one stateless in-process
+router; real ``Agent`` threads that only ever see the router URL. Four
+legs:
+
+- **Sharded drain, bit-identical** — a bulk map-reduce submitted through
+  the router lands whole on its home partition (placement stamp matches
+  the ring computed client-side), drains through the fleet, and the
+  reduce result is bit-identical to a single-controller in-process
+  reference of the same workload.
+- **Cross-partition steal** — the bulk's CSV path is chosen so EVERY
+  shard homes on one partition (skewed submit); agents homed on the
+  other two partitions must steal it (router
+  ``lease_grants_stolen_total`` > 0) instead of idling.
+- **Partition kill** — a second bulk's home partition is SIGKILLed
+  mid-drain; the surviving partitions grant new successes within the
+  poll window (never stall), the victim restarts over its own journal,
+  the drain completes, and the union of the partitions' final journal
+  replays shows every job terminal on exactly one partition and billed
+  exactly once (zero lost / double-applied / double-billed).
+- **429 pass-through** — a second cluster with ``SCHED_MAX_PENDING``
+  set small: the router forwards the home partition's 429 verbatim
+  (``retry_after_ms`` intact) with the home partition stamped into the
+  body, while submits homed on the other partition still land 200 —
+  backpressure is per-partition, not global.
+
+Exit 0 = clean; 1 = problems (listed one per line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from controller_failover_soak import (  # noqa: E402 — shared drill kit
+    JOURNAL_CFG,
+    PLUGIN_SRC,
+    build_csv,
+    canonical,
+    free_port,
+    http_json,
+    make_agent,
+    start_partition_proc,
+    wait_for_status,
+)
+
+from agent_tpu.agent.app import Agent  # noqa: E402
+from agent_tpu.chaos import LoopbackSession  # noqa: E402
+from agent_tpu.config import AgentConfig, Config  # noqa: E402
+from agent_tpu.controller.core import Controller  # noqa: E402
+from agent_tpu.controller.partition import (  # noqa: E402
+    PartitionMap,
+    job_id_for_partition,
+    placement_key,
+)
+from agent_tpu.controller.router import RouterServer  # noqa: E402
+from agent_tpu.sched.steal import StealPolicy  # noqa: E402
+
+SHARDS = 12
+ROWS_PER_SHARD = 25
+SLEEP_MS = 80.0
+SURVIVOR_WINDOW_SEC = 5.0
+DRAIN_DEADLINE_SEC = 60.0
+
+
+def pick_csv_for_home(tmp: str, pmap: PartitionMap, target: str,
+                      stem: str) -> str:
+    """A CSV filename whose placement key lands on ``target`` — how the
+    smoke skews an entire bulk onto one partition deterministically."""
+    for i in range(1000):
+        cand = os.path.join(tmp, f"{stem}{i}.csv")
+        if pmap.ring.place(placement_key(None, f"csv\x1f{cand}")) == target:
+            return cand
+    raise RuntimeError(f"no CSV name landing on {target} in 1000 tries")
+
+
+def reference_reduce(tmp: str, csv_path: str) -> str:
+    """Single-controller in-process drain of the identical workload —
+    the bit-identity anchor for both partitioned bulks."""
+    controller = Controller(
+        lease_ttl_sec=10.0, max_attempts=10, requeue_delay_sec=0.01,
+        sweep_interval_sec=0.1,
+    )
+    agents = [
+        Agent(
+            config=Config(agent=AgentConfig(
+                controller_url="http://loopback", agent_name=f"ref-{i}",
+                tasks=("slow_risk", "risk_accumulate", "echo"),
+                max_tasks=2, idle_sleep_sec=0.01, error_backoff_sec=0.01,
+                retry_base_sec=0.005, retry_max_sec=0.05,
+                pipeline_depth=0,
+            )),
+            session=LoopbackSession(controller),
+        )
+        for i in range(2)
+    ]
+    for a in agents:
+        a._profile = {"tier": "partition-smoke"}
+    threads = [
+        threading.Thread(target=a.run, daemon=True) for a in agents
+    ]
+    try:
+        for t in threads:
+            t.start()
+        _, reduce_id = controller.submit_csv_job(
+            csv_path, total_rows=SHARDS * ROWS_PER_SHARD,
+            shard_size=ROWS_PER_SHARD, map_op="slow_risk",
+            extra_payload={"field": "risk", "sleep_ms": 0.0},
+            reduce_op="risk_accumulate", collect_partials=True,
+        )
+        deadline = time.monotonic() + DRAIN_DEADLINE_SEC
+        while time.monotonic() < deadline and not controller.drained():
+            time.sleep(0.02)
+        if not controller.drained():
+            raise RuntimeError(
+                f"reference drain stuck: {controller.counts()}"
+            )
+        job = controller.job_snapshot(reduce_id)
+        if job["state"] != "succeeded":
+            raise RuntimeError(f"reference reduce {job['state']!r}")
+        return canonical(job["result"])
+    finally:
+        for a in agents:
+            a.request_drain(reason="reference done")
+        for t in threads:
+            t.join(timeout=10)
+        controller.close()
+
+
+def submit_bulk(router_url: str, csv_path: str,
+                sleep_ms: float) -> Tuple[List[str], str, str]:
+    status, body = http_json(router_url + "/v1/jobs", {
+        "source_uri": csv_path,
+        "total_rows": SHARDS * ROWS_PER_SHARD,
+        "shard_size": ROWS_PER_SHARD,
+        "map_op": "slow_risk",
+        "extra_payload": {"field": "risk", "sleep_ms": sleep_ms},
+        "reduce_op": "risk_accumulate",
+        "collect_partials": True,
+    })
+    if status != 200:
+        raise RuntimeError(f"bulk submit: HTTP {status} {body}")
+    return body["job_ids"], body["reduce_id"], body["partition"]
+
+
+def wait_drained(router_url: str, deadline_sec: float) -> bool:
+    deadline = time.monotonic() + deadline_sec
+    while time.monotonic() < deadline:
+        _, body = http_json(router_url + "/v1/status", timeout=3)
+        if (body or {}).get("drained"):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def run_sharded_cluster(tmp: str, reference: str) -> List[str]:
+    """Legs 1–3 on one 3-partition cluster: sharded drain bit-identity,
+    steal under skew, and the partition kill."""
+    problems: List[str] = []
+    names = ["p0", "p1", "p2"]
+    ports = {n: free_port() for n in names}
+    urls = {n: f"http://127.0.0.1:{ports[n]}" for n in names}
+    journals = {
+        n: os.path.join(tmp, f"journal.{n}.jsonl") for n in names
+    }
+    procs = {
+        n: start_partition_proc(n, ports[n], journals[n], {})
+        for n in names
+    }
+    pmap = PartitionMap({n: (urls[n],) for n in names})
+    router: Optional[RouterServer] = None
+    agents: List[Agent] = []
+    threads: List[threading.Thread] = []
+    try:
+        for n in names:
+            if not wait_for_status(urls[n], 20.0):
+                return [f"partition {n} never became healthy"]
+        router = RouterServer(
+            pmap, steal=StealPolicy(enabled=True, min_advantage=1),
+            depth_cache_sec=0.1,
+        ).start()
+        agents = [make_agent(f"pc-{i}", [router.url]) for i in range(3)]
+        threads = [
+            threading.Thread(target=a.run, daemon=True) for a in agents
+        ]
+        for t in threads:
+            t.start()
+
+        # ---- leg 1+2: one skewed bulk — every shard homes on home_a,
+        # so the drain itself proves stealing (3 agents, at most one
+        # homed there) AND the sharded bit-identity.
+        home_a = names[0]
+        csv_a = pick_csv_for_home(tmp, pmap, home_a, "bulk_a")
+        build_csv(csv_a, SHARDS * ROWS_PER_SHARD)
+        shard_ids_a, reduce_a, stamped = submit_bulk(
+            router.url, csv_a, SLEEP_MS
+        )
+        if stamped != home_a:
+            problems.append(
+                f"router stamped {stamped!r} but the ring computed "
+                f"{home_a!r} client-side — placement is not deterministic"
+            )
+        if not wait_drained(router.url, DRAIN_DEADLINE_SEC):
+            _, body = http_json(router.url + "/v1/status", timeout=3)
+            return problems + [
+                f"sharded drain stuck: {(body or {}).get('counts')}"
+            ]
+        status, snap = http_json(
+            router.url + f"/v1/jobs/{reduce_a}", timeout=5
+        )
+        if status != 200 or snap.get("state") != "succeeded":
+            problems.append(
+                f"reduce A: HTTP {status} state "
+                f"{(snap or {}).get('state')!r}"
+            )
+        elif canonical(snap["result"]) != reference:
+            problems.append(
+                "sharded reduce diverged from the single-controller "
+                f"reference\n  want {reference}\n"
+                f"  got  {canonical(snap['result'])}"
+            )
+        stats = router.core.stats()
+        stolen_after_a = stats.get("lease_grants_stolen_total", 0)
+        if stolen_after_a <= 0:
+            problems.append(
+                "skewed bulk drained with zero stolen lease grants — "
+                f"work stealing never engaged (router stats {stats})"
+            )
+
+        # ---- leg 3: a second bulk on a DIFFERENT home; SIGKILL that
+        # home mid-drain; survivors must keep granting.
+        victim = next(n for n in names if n != home_a)
+        csv_b = pick_csv_for_home(tmp, pmap, victim, "bulk_b")
+        build_csv(csv_b, SHARDS * ROWS_PER_SHARD)
+        shard_ids_b, reduce_b, stamped_b = submit_bulk(
+            router.url, csv_b, SLEEP_MS
+        )
+        if stamped_b != victim:
+            problems.append(
+                f"bulk B stamped {stamped_b!r}, expected {victim!r}"
+            )
+        # A few singles that home on the SURVIVORS, so "survivors never
+        # stall" measures real post-kill progress.
+        single_ids: List[str] = []
+        survivors = [n for n in names if n != victim]
+        for k, surv in enumerate(survivors * 3):
+            jid = job_id_for_partition(
+                pmap.ring, surv, prefix=f"pk-single-{k}"
+            )
+            status, body = http_json(router.url + "/v1/jobs", {
+                "op": "slow_risk",
+                "payload": {"values": [1.0], "sleep_ms": SLEEP_MS},
+                "job_id": jid,
+            })
+            if status != 200:
+                problems.append(f"single {jid}: HTTP {status} {body}")
+                continue
+            single_ids.append(jid)
+
+        # Kill once bulk B is genuinely in flight on its home.
+        kill_deadline = time.monotonic() + 30.0
+        while time.monotonic() < kill_deadline:
+            _, ps = http_json(urls[victim] + "/v1/status", timeout=3)
+            by_op = (ps or {}).get("counts_by_op", {})
+            if by_op.get("slow_risk", {}).get("succeeded", 0) >= 2:
+                break
+            time.sleep(0.05)
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait(timeout=10)
+
+        def survivor_succeeded() -> int:
+            total = 0
+            _, sbody = http_json(router.url + "/v1/status", timeout=3)
+            for row in (sbody or {}).get("partitions", []):
+                if row.get("name") != victim and row.get("ok"):
+                    total += int(
+                        (row.get("counts") or {}).get("succeeded", 0)
+                    )
+            return total
+
+        base = survivor_succeeded()
+        stall_deadline = time.monotonic() + SURVIVOR_WINDOW_SEC
+        stalled = True
+        while time.monotonic() < stall_deadline:
+            if survivor_succeeded() > base:
+                stalled = False
+                break
+            time.sleep(0.05)
+        if stalled:
+            problems.append(
+                "surviving partitions granted nothing within "
+                f"{SURVIVOR_WINDOW_SEC}s of the {victim} kill — the "
+                "fleet stalled behind a dead partition"
+            )
+
+        # Restart the victim over its own journal: replay requeues its
+        # in-flight shards; the drain must then complete.
+        procs[victim] = start_partition_proc(
+            victim, ports[victim], journals[victim], {}
+        )
+        if not wait_for_status(urls[victim], 20.0):
+            return problems + [
+                f"killed partition {victim} never came back"
+            ]
+        if not wait_drained(router.url, DRAIN_DEADLINE_SEC):
+            _, body = http_json(router.url + "/v1/status", timeout=3)
+            return problems + [
+                f"post-kill drain stuck: {(body or {}).get('counts')}"
+            ]
+        status, snap = http_json(
+            router.url + f"/v1/jobs/{reduce_b}", timeout=5
+        )
+        if status != 200 or snap.get("state") != "succeeded":
+            problems.append(
+                f"reduce B: HTTP {status} state "
+                f"{(snap or {}).get('state')!r}"
+            )
+        elif canonical(snap["result"]) != reference:
+            problems.append(
+                "post-kill reduce diverged from the reference\n"
+                f"  want {reference}\n"
+                f"  got  {canonical(snap['result'])}"
+            )
+
+        # ---- fleet retires through the drain path (spool flushes) ----
+        for a in agents:
+            a.request_drain(reason="smoke done")
+        for t in threads:
+            t.join(timeout=15)
+        leftover = [len(a.spool) for a in agents if len(a.spool)]
+        if leftover:
+            problems.append(f"agents left spooled results: {leftover}")
+
+        # ---- exactly-once across the union of the journals ----
+        expected = (
+            set(shard_ids_a) | set(shard_ids_b)
+            | {reduce_a, reduce_b} | set(single_ids)
+        )
+        for n in names:
+            procs[n].terminate()
+            procs[n].wait(timeout=10)
+        owners: Dict[str, List[str]] = {}
+        billed_total = 0
+        for n in names:
+            replayed = Controller(
+                partition=n, journal_path=journals[n],
+                journal=JOURNAL_CFG,
+            )
+            try:
+                if (replayed.journal_torn_tail
+                        or replayed.journal_replay_skipped):
+                    problems.append(
+                        f"{n} journal damage (torn "
+                        f"{replayed.journal_torn_tail}, skipped "
+                        f"{replayed.journal_replay_skipped})"
+                    )
+                for jid in expected:
+                    try:
+                        jsnap = replayed.job_snapshot(jid)
+                    except KeyError:
+                        continue
+                    owners.setdefault(jid, []).append(n)
+                    if jsnap["state"] != "succeeded":
+                        problems.append(
+                            f"{n}: {jid} state {jsnap['state']!r}"
+                        )
+                if replayed.usage is not None:
+                    billed_total += replayed.usage.billed_tasks
+                    multi = {
+                        jid: cnt for jid, cnt in
+                        replayed.usage.job_billed_attempts().items()
+                        if cnt != 1
+                    }
+                    if multi:
+                        problems.append(
+                            f"{n} billed != once: "
+                            f"{dict(list(multi.items())[:5])}"
+                        )
+            finally:
+                replayed.close()
+        lost = [jid for jid in expected if jid not in owners]
+        if lost:
+            problems.append(
+                f"{len(lost)} job(s) on no partition journal: "
+                f"{sorted(lost)[:5]}"
+            )
+        double = {j: ps for j, ps in owners.items() if len(ps) > 1}
+        if double:
+            problems.append(
+                "jobs applied on multiple partitions: "
+                f"{dict(list(double.items())[:5])}"
+            )
+        if billed_total != len(expected):
+            problems.append(
+                f"fleet billed {billed_total} != jobs {len(expected)}"
+            )
+
+        print(json.dumps({
+            "leg": "sharded+steal+kill", "victim": victim,
+            "jobs": len(expected),
+            "stolen_grants": stolen_after_a,
+            "router": router.core.stats(), "ok": not problems,
+        }, sort_keys=True))
+        return problems
+    finally:
+        for a in agents:
+            a.request_drain(reason="cleanup")
+        for t in threads:
+            t.join(timeout=10)
+        if router is not None:
+            router.stop()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
+def run_backpressure(tmp: str) -> List[str]:
+    """Leg 4: a 2-partition cluster with a 3-job admission budget — the
+    router must pass the home partition's 429 through untouched (with
+    ``retry_after_ms``) and stamp which partition said no, while the
+    other partition keeps accepting."""
+    problems: List[str] = []
+    names = ["q0", "q1"]
+    ports = {n: free_port() for n in names}
+    urls = {n: f"http://127.0.0.1:{ports[n]}" for n in names}
+    procs = {
+        n: start_partition_proc(
+            n, ports[n], os.path.join(tmp, f"bp.{n}.jsonl"),
+            {"SCHED_MAX_PENDING": "3"},
+        )
+        for n in names
+    }
+    pmap = PartitionMap({n: (urls[n],) for n in names})
+    router: Optional[RouterServer] = None
+    try:
+        for n in names:
+            if not wait_for_status(urls[n], 20.0):
+                return [f"backpressure partition {n} never healthy"]
+        router = RouterServer(pmap).start()
+
+        # Fill q0 to its budget with ids the ring homes there; nothing
+        # leases (no agents), so the 4th submit must 429.
+        got_429: Optional[Tuple[int, Any]] = None
+        for k in range(4):
+            jid = job_id_for_partition(
+                pmap.ring, "q0", prefix=f"bp-{k}"
+            )
+            status, body = http_json(router.url + "/v1/jobs", {
+                "op": "echo", "payload": {"k": k}, "job_id": jid,
+            })
+            if status == 429:
+                got_429 = (status, body)
+                break
+            if status != 200:
+                problems.append(f"fill submit {k}: HTTP {status} {body}")
+        if got_429 is None:
+            problems.append(
+                "4 submits past a 3-job budget never 429ed — admission "
+                "is not enforced through the router"
+            )
+        else:
+            _, body = got_429
+            if not isinstance(body, dict):
+                problems.append(f"429 body not JSON: {body!r}")
+            else:
+                if "retry_after_ms" not in body:
+                    problems.append(
+                        f"429 body lost retry_after_ms: {body}"
+                    )
+                if body.get("partition") != "q0":
+                    problems.append(
+                        "429 body does not name the rejecting "
+                        f"partition: {body}"
+                    )
+        # The OTHER partition's budget is untouched: its home submits
+        # still land — rejection is per-partition, not fleet-wide.
+        jid = job_id_for_partition(pmap.ring, "q1", prefix="bp-ok")
+        status, body = http_json(router.url + "/v1/jobs", {
+            "op": "echo", "payload": {"k": -1}, "job_id": jid,
+        })
+        if status != 200:
+            problems.append(
+                f"submit homed on the un-full partition got HTTP "
+                f"{status} {body} — backpressure leaked fleet-wide"
+            )
+        print(json.dumps({
+            "leg": "backpressure",
+            "rejected": got_429 is not None,
+            "router": router.core.stats() if router else {},
+            "ok": not problems,
+        }, sort_keys=True))
+        return problems
+    finally:
+        if router is not None:
+            router.stop()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
+def main() -> int:
+    # slow_risk through the designed plugin channel (agents run it
+    # in-process; the subprocess partitions never execute ops).
+    from agent_tpu.ops import load_plugins
+
+    tmp_root = tempfile.mkdtemp(prefix="partition_smoke_plugin_")
+    plugin_path = os.path.join(tmp_root, "slow_risk_plugin.py")
+    with open(plugin_path, "w", encoding="utf-8") as f:
+        f.write(PLUGIN_SRC)
+    if "slow_risk" not in load_plugins(plugin_path):
+        from agent_tpu.ops import OPS_LOAD_ERRORS
+
+        print(f"slow_risk plugin failed to load: {OPS_LOAD_ERRORS}")
+        return 1
+
+    t0 = time.monotonic()
+    problems: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="partition_smoke_") as tmp:
+        # The reference drains the SAME rows the partitioned bulks use
+        # (build_csv is deterministic in row count), so one reference
+        # anchors both reduces.
+        ref_csv = os.path.join(tmp, "reference.csv")
+        build_csv(ref_csv, SHARDS * ROWS_PER_SHARD)
+        try:
+            reference = reference_reduce(tmp, ref_csv)
+        except RuntimeError as exc:
+            print(f"reference run failed: {exc}")
+            return 1
+        problems += run_sharded_cluster(tmp, reference)
+        problems += run_backpressure(tmp)
+    elapsed = round(time.monotonic() - t0, 1)
+    if problems:
+        for p in problems:
+            print(p)
+        print(f"FAILED: {len(problems)} problem(s) in {elapsed}s")
+        return 1
+    print(f"partitioned controller smoke: OK ({elapsed}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,7 +34,8 @@ import tempfile
 import time
 from typing import Any, Dict, List, Optional
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 from agent_tpu.config import JournalConfig
 from agent_tpu.controller.core import Controller
@@ -163,20 +164,75 @@ def bench_replay(n_events: int, live: int, tmp: str) -> Dict[str, Any]:
     }
 
 
+def bench_partitioned_submits(
+    submits: int, partitions: int, tmp: str
+) -> Dict[str, Any]:
+    """Aggregate submit throughput of N partitions running CONCURRENTLY
+    in separate processes (ISSUE 18) — each partition a real
+    ``Controller`` journaling to its own segmented journal, exactly the
+    per-partition write path of the partitioned control plane. Separate
+    processes because that is the deployment shape AND the measurement
+    requirement: N controllers in one process share a GIL and would bench
+    lock contention, not scaling. Aggregate = total submits / slowest
+    child wall (children start together; python startup is excluded
+    because each child times only its own submit loop)."""
+    import subprocess
+
+    procs = []
+    for i in range(partitions):
+        path = os.path.join(tmp, f"agg_submit.p{i}.jsonl")
+        procs.append(subprocess.Popen(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--_child-submits", str(submits),
+                "--_child-journal", path,
+                "--_child-partition", f"p{i}",
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=REPO,
+        ))
+    total = 0
+    walls: List[float] = []
+    for proc in procs:
+        out, err = proc.communicate(timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"partition child failed rc={proc.returncode}: "
+                f"{err.decode(errors='replace')[:300]}"
+            )
+        child = json.loads(out.decode())
+        total += child["submits"]
+        walls.append(child["wall_s"])
+    wall = max(walls)
+    return {
+        "partitions": partitions,
+        "submits": total,
+        "agg_submits_per_sec": round(total / wall, 1),
+        "child_walls_s": [round(w, 4) for w in walls],
+        "wall_s": round(wall, 4),
+    }
+
+
 def run_bench(
     submits: int = 20_000,
     lease_jobs: int = 20_000,
     grant: int = 16,
     replay_events: int = 50_000,
     replay_live: int = 500,
+    partitions: int = 0,
 ) -> Dict[str, Any]:
-    """All three legs → one flat dict (the ``controller_*`` bench
-    fields). Importable — ``bench.py``'s controller leg calls this."""
+    """All legs → one flat dict (the ``controller_*`` bench fields).
+    Importable — ``bench.py``'s controller leg calls this.
+    ``partitions > 0`` adds the ISSUE 18 aggregate-submits leg (N
+    concurrent partition processes) and its ``agg_*`` fields."""
     with tempfile.TemporaryDirectory(prefix="controller_bench_") as tmp:
         sub = bench_submits(submits, tmp)
         lease = bench_leases(lease_jobs, grant, tmp)
         replay = bench_replay(replay_events, replay_live, tmp)
-    return {
+        agg = (
+            bench_partitioned_submits(submits, partitions, tmp)
+            if partitions > 0 else None
+        )
+    out = {
         "submits_per_sec": sub["submits_per_sec"],
         "lease_grants_per_sec": lease["lease_grants_per_sec"],
         "tasks_leased_per_sec": lease["tasks_leased_per_sec"],
@@ -187,6 +243,21 @@ def run_bench(
         "replay_speedup": replay["replay_speedup"],
         "detail": {"submit": sub, "lease": lease, "replay": replay},
     }
+    if agg is not None:
+        host_cores = os.cpu_count() or 1
+        out["agg_partitions"] = partitions
+        out["agg_submits_per_sec"] = agg["agg_submits_per_sec"]
+        out["agg_speedup_vs_single"] = round(
+            agg["agg_submits_per_sec"] / max(1e-9, sub["submits_per_sec"]),
+            2,
+        )
+        # Core-count-aware floor: N partition children + the parent need
+        # real cores or the leg measures scheduling starvation, not the
+        # control plane (the ISSUE 16 starved_fields convention).
+        out["agg_starved"] = host_cores < partitions + 1
+        out["host_cores"] = host_cores
+        out["detail"]["agg"] = agg
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -203,7 +274,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--quick", action="store_true",
                     help="CI sizing (replay stays >= 50k events — the "
                          "acceptance bar's floor)")
+    ap.add_argument("--partitions", type=int, default=0,
+                    help="also bench N concurrent partition processes "
+                         "(ISSUE 18); records controller_agg_submits_"
+                         "per_sec and asserts aggregate >= 2x single on "
+                         "hosts with enough cores")
+    # Hidden child mode: one partition's submit loop in its own process.
+    ap.add_argument("--_child-submits", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--_child-journal", default="",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--_child-partition", default="",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+
+    if args._child_submits > 0:
+        # Child mode — one partition, own journal, JSON on stdout.
+        tmp = os.path.dirname(args._child_journal) or "."
+        c = Controller(
+            journal_path=args._child_journal, journal=SEG_CFG,
+            partition=args._child_partition or None,
+        )
+        t0 = time.perf_counter()
+        for i in range(args._child_submits):
+            c.submit("echo", {"i": i})
+        dt = time.perf_counter() - t0
+        c.close()
+        print(json.dumps({
+            "partition": args._child_partition,
+            "submits": args._child_submits,
+            "wall_s": dt,
+            "tmp": tmp,
+        }), flush=True)
+        return 0
+
     if args.quick:
         args.submits = min(args.submits, 10_000)
         args.lease_jobs = min(args.lease_jobs, 10_000)
@@ -211,7 +315,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     out = run_bench(
         submits=args.submits, lease_jobs=args.lease_jobs,
         grant=args.grant, replay_events=args.replay_events,
-        replay_live=args.replay_live,
+        replay_live=args.replay_live, partitions=args.partitions,
     )
     print(json.dumps(out, sort_keys=True), flush=True)
     if args.assert_speedup > 0 and out["replay_speedup"] < args.assert_speedup:
@@ -221,6 +325,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             "journal — snapshot replay is not O(live state)"
         )
         return 1
+    if args.partitions > 0:
+        if out["agg_starved"]:
+            print(
+                f"STARVED: {out['host_cores']} cores < "
+                f"{args.partitions + 1} needed — aggregate recorded "
+                "but the >=2x floor is not asserted", file=sys.stderr,
+            )
+        elif out["agg_speedup_vs_single"] < 2.0:
+            print(
+                f"FAILED: aggregate {out['agg_submits_per_sec']}/s is "
+                f"only {out['agg_speedup_vs_single']}x the single-"
+                f"partition {out['submits_per_sec']}/s across "
+                f"{args.partitions} partitions on a {out['host_cores']}-"
+                "core host — sharding is not scaling submits"
+            )
+            return 1
     return 0
 
 

@@ -37,6 +37,19 @@ Asserts (the ISSUE 14 acceptance bar):
 - the promoted incarnation's ``/v1/status`` ``journal`` block rides real
   HTTP with ``promotions: 1``.
 
+``--partitions N`` (N > 1) swaps the drill for the ISSUE 18
+**partition_kill** variant: N partition subprocesses (each with its own
+segmented journal) behind one stateless in-process router; agents and the
+submitter only ever see the router URL. Mid-drain the bulk job's home
+partition is SIGKILLed. Asserts: surviving partitions land NEW successes
+within ``--survivor-window-sec`` of the kill (never stall), the victim
+restarts over its own journal (replay requeues, restart seals the torn
+death write), spooled results redeliver through the router's tagged lease
+ids, the drain completes with the reduce bit-identical to the calm
+reference, and the union of the partitions' final journal replays shows
+every job terminal on exactly one partition, billed exactly once, zero
+torn/skipped lines.
+
 Exit 0 = all seeds clean; 1 = problems (listed one per line). CI runs
 ``--quick --seed 7`` (CPU-shaped, < 90 s).
 """
@@ -644,6 +657,411 @@ def run_failover(
             primary.wait(timeout=10)
 
 
+def start_partition_proc(
+    name: str, port: int, journal_path: str, extra_env: Dict[str, str],
+) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.update(
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        CONTROLLER_HOST="127.0.0.1",
+        CONTROLLER_PORT=str(port),
+        CONTROLLER_JOURNAL=journal_path,
+        CONTROLLER_PARTITION=name,
+        JOURNAL_SEGMENT_MAX_BYTES=str(JOURNAL_CFG.segment_max_bytes),
+        SNAPSHOT_EVERY_EVENTS=str(JOURNAL_CFG.snapshot_every_events),
+        LEASE_TTL_SEC="3",
+        MAX_ATTEMPTS="10",
+        REQUEUE_DELAY_SEC="0.01",
+        CONTROLLER_SWEEP_SEC="0.2",
+    )
+    env.update(extra_env)
+    return subprocess.Popen(
+        [sys.executable, "-m", "agent_tpu.controller.server"],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def run_partition_kill(
+    tmp: str, csv_path: str, shards: int, rows_per_shard: int, seed: int,
+    args: Any, reference: Dict[str, Any],
+) -> List[str]:
+    """The ISSUE 18 drill: N partition subprocesses behind one stateless
+    router; SIGKILL the partition that owns the bulk job mid-drain.
+    Survivors must keep granting leases (never stall), the killed
+    partition's jobs requeue on restart via journal replay, and the end
+    state is bit-identical / billed-exactly-once across the union of the
+    partitions' journals."""
+    problems: List[str] = []
+    n = args.partitions
+    names = [f"p{i}" for i in range(n)]
+    ports = {name: free_port() for name in names}
+    urls = {name: f"http://127.0.0.1:{ports[name]}" for name in names}
+    journals = {
+        name: os.path.join(tmp, f"journal.{name}.jsonl") for name in names
+    }
+    procs: Dict[str, subprocess.Popen] = {}
+    router = None
+    agents: List[Agent] = []
+    threads: List[threading.Thread] = []
+    try:
+        for name in names:
+            procs[name] = start_partition_proc(
+                name, ports[name], journals[name], {}
+            )
+        for name in names:
+            if not wait_for_status(urls[name], 20.0):
+                problems.append(
+                    f"seed {seed}: partition {name} never became healthy"
+                )
+                return problems
+
+        from agent_tpu.controller.partition import PartitionMap
+        from agent_tpu.controller.router import RouterServer
+        from agent_tpu.sched.steal import StealPolicy
+
+        pmap = PartitionMap({name: (urls[name],) for name in names})
+        router = RouterServer(
+            pmap, steal=StealPolicy(enabled=True, min_advantage=1),
+            depth_cache_sec=0.1,
+        ).start()
+
+        agents = [
+            make_agent(f"pk-{seed}-{i}", [router.url])
+            for i in range(args.agents)
+        ]
+        threads = [
+            threading.Thread(target=a.run, name=f"pk-agent-{i}",
+                             daemon=True)
+            for i, a in enumerate(agents)
+        ]
+        for t in threads:
+            t.start()
+
+        # TWO bulk CSVs on two different partitions: bulk A's home is the
+        # kill target (the partition with the most to lose mid-drain);
+        # bulk B keeps a SURVIVOR partition busy across the kill so the
+        # never-stall assertion measures real survivor progress, not an
+        # accidentally-empty fleet. Same rows, so both reduces must match
+        # the calm reference bit for bit. CSV placement keys on
+        # source_uri, so the B home is picked client-side by filename.
+        from agent_tpu.controller.partition import placement_key
+
+        home_a = pmap.ring.place(placement_key(None, f"csv\x1f{csv_path}"))
+        csv_b = None
+        for i in range(1000):
+            cand = os.path.join(tmp, f"rows_b{i}.csv")
+            if pmap.ring.place(
+                placement_key(None, f"csv\x1f{cand}")
+            ) != home_a:
+                csv_b = cand
+                break
+        if csv_b is None:
+            problems.append(
+                f"seed {seed}: could not place a second bulk off {home_a}"
+            )
+            return problems
+        import shutil
+
+        shutil.copyfile(csv_path, csv_b)
+
+        def submit_bulk(path: str) -> Tuple[List[str], str, str]:
+            status, body = http_json(router.url + "/v1/jobs", {
+                "source_uri": path,
+                "total_rows": shards * rows_per_shard,
+                "shard_size": rows_per_shard,
+                "map_op": "slow_risk",
+                "extra_payload": {
+                    "field": "risk", "sleep_ms": args.sleep_ms,
+                },
+                "reduce_op": "risk_accumulate",
+                "collect_partials": True,
+            })
+            if status != 200:
+                raise RuntimeError(
+                    f"bulk submit via router failed: HTTP {status} {body}"
+                )
+            return body["job_ids"], body["reduce_id"], body["partition"]
+
+        try:
+            shard_ids_a, reduce_a, victim = submit_bulk(csv_path)
+            shard_ids_b, reduce_b, home_b = submit_bulk(csv_b)
+        except RuntimeError as exc:
+            problems.append(f"seed {seed}: {exc}")
+            return problems
+        if victim != home_a or home_b == victim or victim not in names:
+            problems.append(
+                f"seed {seed}: placement disagrees with the router "
+                f"(computed A={home_a} B!={home_a}, stamped A={victim} "
+                f"B={home_b}) — the hash is not deterministic across "
+                "processes"
+            )
+            return problems
+        shard_ids = shard_ids_a + shard_ids_b
+        n_bulk_shards = len(shard_ids)
+        submitter = SingleSubmitter(
+            [router.url], seed, args.singles, args.submit_window_sec
+        ).start()
+
+        # ---- SIGKILL bulk A's home partition once mid-drain ----
+        plan = FaultPlan(seed=seed, controller_kill=args.kill_prob)
+        kill_floor = max(1, int(n_bulk_shards * args.kill_after_frac))
+        force_deadline = time.monotonic() + args.kill_deadline_sec
+        kills = 0
+        succeeded_at_kill = 0
+        while kills == 0:
+            try:
+                status, body = http_json(
+                    router.url + "/v1/status", timeout=3
+                )
+                by_op = (body or {}).get("counts_by_op", {})
+                shards_done = by_op.get("slow_risk", {}).get(
+                    "succeeded", 0
+                )
+            except Exception:  # noqa: BLE001 — router must stay up
+                problems.append(
+                    f"seed {seed}: router unreachable before the kill"
+                )
+                return problems
+            armed = shards_done >= kill_floor
+            forced = (
+                time.monotonic() > force_deadline
+                or shards_done >= max(
+                    kill_floor + 1, int(n_bulk_shards * 0.6)
+                )
+            )
+            if armed and (plan.decide("controller_kill") or forced):
+                procs[victim].send_signal(signal.SIGKILL)
+                procs[victim].wait(timeout=10)
+                kills += 1
+                succeeded_at_kill = shards_done
+                break
+            time.sleep(0.05)
+        if succeeded_at_kill >= n_bulk_shards:
+            problems.append(
+                f"seed {seed}: partition kill landed too late "
+                f"({succeeded_at_kill} >= {n_bulk_shards} shards done) — "
+                "raise --sleep-ms"
+            )
+
+        # ---- survivors never stall: succeeded counts on the surviving
+        # partitions keep climbing while the victim is dark ----
+        def survivor_succeeded() -> int:
+            total = 0
+            _, sbody = http_json(router.url + "/v1/status", timeout=3)
+            for row in (sbody or {}).get("partitions", []):
+                if row.get("name") != victim and row.get("ok"):
+                    total += int(
+                        (row.get("counts") or {}).get("succeeded", 0)
+                    )
+            return total
+
+        base = survivor_succeeded()
+        stall_deadline = time.monotonic() + args.survivor_window_sec
+        survivor_latency = None
+        while time.monotonic() < stall_deadline:
+            if survivor_succeeded() > base:
+                survivor_latency = round(
+                    args.survivor_window_sec
+                    - (stall_deadline - time.monotonic()), 3,
+                )
+                break
+            time.sleep(0.05)
+        if survivor_latency is None:
+            problems.append(
+                f"seed {seed}: surviving partitions stalled — no new "
+                f"successes within {args.survivor_window_sec}s of the "
+                f"{victim} kill"
+            )
+
+        # ---- the killed partition restarts over its own journal:
+        # replay requeues its in-flight jobs (epoch-fenced), spooled
+        # results redeliver through the router's lease-id tag ----
+        procs[victim] = start_partition_proc(
+            victim, ports[victim], journals[victim], {}
+        )
+        if not wait_for_status(urls[victim], 20.0):
+            problems.append(
+                f"seed {seed}: killed partition {victim} never came back"
+            )
+            return problems
+
+        submitter.join(timeout=args.submit_window_sec + 60.0)
+        expected = (
+            set(shard_ids) | {reduce_a, reduce_b}
+            | set(submitter.submitted)
+        )
+        n_jobs = len(expected)
+        if len(submitter.submitted) != args.singles:
+            problems.append(
+                f"seed {seed}: only {len(submitter.submitted)}/"
+                f"{args.singles} singles submitted across the kill"
+            )
+
+        deadline = time.monotonic() + args.deadline_sec
+        drained = False
+        while time.monotonic() < deadline:
+            _, sbody = http_json(router.url + "/v1/status", timeout=3)
+            if (sbody or {}).get("drained"):
+                drained = True
+                break
+            time.sleep(0.1)
+        if not drained:
+            _, sbody = http_json(router.url + "/v1/status", timeout=3)
+            problems.append(
+                f"seed {seed}: partitioned drain did not complete "
+                f"(counts {(sbody or {}).get('counts')})"
+            )
+            # Name the stuck jobs and where they live — a chaos drill
+            # that fails with a bare count is undebuggable.
+            for jid in sorted(expected):
+                _, jsnap = http_json(
+                    router.url + f"/v1/jobs/{jid}", timeout=3
+                )
+                state = (jsnap or {}).get("state")
+                if state != "succeeded":
+                    problems.append(
+                        f"seed {seed}:   stuck {jid}: state {state!r}"
+                    )
+            for name in names:
+                _, ps = http_json(urls[name] + "/v1/status", timeout=3)
+                _, pd = http_json(urls[name] + "/v1/depth", timeout=3)
+                problems.append(
+                    f"seed {seed}:   {name} counts="
+                    f"{(ps or {}).get('counts')} depth={pd}"
+                )
+            return problems
+
+        # ---- both reduces bit-identical, via the by-id fan-out ----
+        for tag, rid in (("A", reduce_a), ("B", reduce_b)):
+            status, snap = http_json(
+                router.url + f"/v1/jobs/{rid}", timeout=5
+            )
+            if status != 200 or snap.get("state") != "succeeded":
+                problems.append(
+                    f"seed {seed}: reduce {tag} {rid} HTTP {status} state "
+                    f"{(snap or {}).get('state')!r}"
+                )
+                continue
+            got = canonical(snap["result"])
+            if got != reference.get("reduce"):
+                problems.append(
+                    f"seed {seed}: reduce {tag} diverged across the "
+                    f"partition kill\n  want {reference.get('reduce')}\n"
+                    f"  got  {got}"
+                )
+
+        router_stats = router.core.stats()
+
+        # ---- retire the fleet, then flush any spooled results ----
+        for a in agents:
+            a.request_drain(reason="partition drill done")
+        for t in threads:
+            t.join(timeout=15)
+        leftover = [len(a.spool) for a in agents if len(a.spool)]
+        if leftover:
+            problems.append(
+                f"seed {seed}: agents left spooled results: {leftover}"
+            )
+
+        # ---- final per-partition journal replay: the union of the
+        # partitions' journals is the fleet state — every job terminal
+        # on exactly one partition, billed exactly once, no torn/skipped
+        # lines (restart sealed the SIGKILL's torn death write) ----
+        for name in names:
+            procs[name].terminate()
+            procs[name].wait(timeout=10)
+        states: Dict[str, str] = {}
+        owners: Dict[str, List[str]] = {}
+        billed_total = 0
+        for name in names:
+            replayed = Controller(
+                partition=name, journal_path=journals[name],
+                journal=JOURNAL_CFG,
+            )
+            try:
+                if (replayed.journal_torn_tail
+                        or replayed.journal_replay_skipped):
+                    problems.append(
+                        f"seed {seed}: {name} journal damage after the "
+                        f"drill (torn {replayed.journal_torn_tail}, "
+                        f"skipped {replayed.journal_replay_skipped}) — "
+                        "restart failed to seal the torn tail"
+                    )
+                if replayed.queue_depth() != 0:
+                    problems.append(
+                        f"seed {seed}: {name} replayed queue depth "
+                        f"{replayed.queue_depth()} != 0"
+                    )
+                for jid in expected:
+                    try:
+                        jsnap = replayed.job_snapshot(jid)
+                    except KeyError:
+                        continue
+                    owners.setdefault(jid, []).append(name)
+                    states[jid] = jsnap["state"]
+                if replayed.usage is not None:
+                    billed_total += replayed.usage.billed_tasks
+                    multi = {
+                        jid: cnt for jid, cnt in
+                        replayed.usage.job_billed_attempts().items()
+                        if cnt != 1
+                    }
+                    if multi:
+                        problems.append(
+                            f"seed {seed}: {name} billed != once: "
+                            f"{dict(list(multi.items())[:5])}"
+                        )
+            finally:
+                replayed.close()
+        lost = [jid for jid in expected if jid not in owners]
+        if lost:
+            problems.append(
+                f"seed {seed}: {len(lost)} job(s) on no partition "
+                f"journal (lost): {sorted(lost)[:5]}"
+            )
+        double = {jid: ps for jid, ps in owners.items() if len(ps) > 1}
+        if double:
+            problems.append(
+                f"seed {seed}: jobs applied on multiple partitions: "
+                f"{dict(list(double.items())[:5])}"
+            )
+        bad_state = {
+            jid: s for jid, s in states.items() if s != "succeeded"
+        }
+        if bad_state:
+            problems.append(
+                f"seed {seed}: non-terminal jobs after the drill: "
+                f"{dict(list(bad_state.items())[:5])}"
+            )
+        if billed_total != n_jobs:
+            problems.append(
+                f"seed {seed}: fleet billed {billed_total} != jobs "
+                f"{n_jobs} (lost or double-billed work)"
+            )
+
+        print(json.dumps({
+            "scenario": "partition_kill", "seed": seed,
+            "partitions": n, "victim": victim,
+            "jobs": n_jobs, "singles": len(submitter.submitted),
+            "duplicate_acks": submitter.duplicate_acks,
+            "survivor_first_success_sec": survivor_latency,
+            "router": router_stats, "ok": not problems,
+        }, sort_keys=True))
+        return problems
+    finally:
+        for a in agents:
+            a.request_drain(reason="cleanup")
+        for t in threads:
+            t.join(timeout=10)
+        if router is not None:
+            router.stop()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=7)
@@ -667,6 +1085,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="per-shard service time — what keeps the drain "
                          "in flight long enough to kill mid-drain")
     ap.add_argument("--deadline-sec", type=float, default=90.0)
+    ap.add_argument("--partitions", type=int, default=1,
+                    help="> 1 runs the ISSUE 18 partition_kill drill: N "
+                         "partition subprocesses behind one stateless "
+                         "router; the bulk job's home partition is "
+                         "SIGKILLed mid-drain and restarted over its "
+                         "journal. 1 (default) keeps the classic "
+                         "single-controller standby-promotion drill.")
+    ap.add_argument("--survivor-window-sec", type=float, default=5.0,
+                    help="partition_kill: surviving partitions must land "
+                         "a NEW success within this window of the kill "
+                         "(the never-stall bar)")
     ap.add_argument("--quick", action="store_true",
                     help="CI sizing: shrinks the workload for < 90 s")
     args = ap.parse_args(argv)
@@ -709,7 +1138,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 args,
             )
             problems += ref_problems
-            if not ref_problems:
+            if not ref_problems and args.partitions > 1:
+                problems += run_partition_kill(
+                    tmp, csv_path, args.shards, args.rows_per_shard,
+                    seed, args, reference,
+                )
+            elif not ref_problems:
                 problems += run_failover(
                     tmp, csv_path, args.shards, args.rows_per_shard,
                     seed, args, reference,
@@ -720,8 +1154,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(p)
         print(f"FAILED: {len(problems)} problem(s) in {elapsed}s")
         return 1
+    drill = (
+        f"partition_kill x{args.partitions}" if args.partitions > 1
+        else "controller failover"
+    )
     print(
-        f"controller failover soak: OK ({len(seeds)} seed(s), "
+        f"{drill} soak: OK ({len(seeds)} seed(s), "
         f"{args.shards} shards, {elapsed}s)"
     )
     return 0

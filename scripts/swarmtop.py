@@ -206,6 +206,44 @@ def request_tail(base: str, limit: int = 5):
     return out
 
 
+def partition_rows(status, health):
+    """Per-partition rows for a partitioned control plane (ISSUE 18):
+    the router's merged ``/v1/status`` carries one row per partition
+    (reachability, counts, queue, journal block); the merged health's
+    partition-stamped reasons turn into a per-partition verdict. None
+    against a plain single controller."""
+    if not isinstance(status, dict) or not status.get("partitioned"):
+        return None
+    flagged = set()
+    for r in (health or {}).get("reasons") or []:
+        if isinstance(r, dict) and r.get("partition"):
+            flagged.add(r["partition"])
+    rows = []
+    for row in status.get("partitions") or []:
+        name = row.get("name")
+        ok = bool(row.get("ok"))
+        counts = row.get("counts") or {}
+        j = row.get("journal") or {}
+        rows.append({
+            "name": name,
+            "ok": ok,
+            "verdict": (
+                "page" if not ok
+                else ("warn" if name in flagged else "ok")
+            ),
+            "queue_depth": row.get("queue_depth"),
+            "succeeded": counts.get("succeeded", 0),
+            "pending": counts.get("pending", 0),
+            "running": counts.get("running", 0),
+            "drained": row.get("drained"),
+            "journal_segments": j.get("segments"),
+            "journal_bytes": j.get("bytes"),
+            "snapshot_age_sec": j.get("last_snapshot_age_sec"),
+            "promotions": j.get("promotions"),
+        })
+    return rows
+
+
 def tasks_total(metrics_text) -> float:
     """Fleet-wide completed tasks off the exposition (unlabeled merge only —
     ``agent``-labeled duplicates would double-count). The scrape-delta
@@ -283,7 +321,7 @@ def last_value(points):
 
 
 def render(health, status, rate, colors: Colors, trends=None,
-           serving=None, req_tail=None) -> str:
+           serving=None, req_tail=None, partitions=None) -> str:
     lines = []
     verdict = health.get("verdict", "?")
     now = time.strftime("%H:%M:%S")
@@ -432,6 +470,44 @@ def render(health, status, rate, colors: Colors, trends=None,
             ), DIM))
     lines.append("")
 
+    if partitions:
+        # Partitioned control plane (ISSUE 18): one row per controller
+        # partition behind the router — reachability, its own queue and
+        # journal — beside the fleet merge above, so a killed partition
+        # reads as one red row, not a mystery dip in the fleet line.
+        lines.append(colors.paint(f"Partitions ({len(partitions)})", BOLD))
+        lines.append(colors.paint(
+            f"  {'partition':<12}{'state':<7}{'queue':>7}{'done':>7}"
+            f"{'pending':>9}{'segs':>6}{'journal':>10}{'snap age':>10}",
+            DIM))
+        for p in partitions:
+            label = "down" if not p.get("ok") else str(
+                p.get("verdict", "?"))
+            state_cell = colors.paint(
+                label.upper(), FG.get(p.get("verdict"), ""), BOLD
+            ) + " " * max(0, 7 - len(label))
+            jb = p.get("journal_bytes")
+            jb_s = (f"{jb / 1024:.0f}KB"
+                    if isinstance(jb, (int, float)) else "-")
+            lines.append(
+                f"  {str(p.get('name'))[:11]:<12}"
+                f"{state_cell}"
+                f"{fmt_num(p.get('queue_depth'), 0):>7}"
+                f"{p.get('succeeded', 0):>7}"
+                f"{p.get('pending', 0):>9}"
+                f"{fmt_num(p.get('journal_segments'), 0):>6}"
+                f"{jb_s:>10}"
+                f"{fmt_num(p.get('snapshot_age_sec'), 1):>10}"
+            )
+        router = (status or {}).get("router") or {}
+        router_s = " ".join(
+            f"{k}={v}" for k, v in sorted(router.items())
+            if isinstance(v, (int, float))
+        )
+        if router_s:
+            lines.append(colors.paint(f"  router: {router_s}", DIM))
+        lines.append("")
+
     fleet = health.get("fleet", {})
     lines.append(colors.paint(
         f"Agents ({fleet.get('n_agents', 0)} seen, "
@@ -511,6 +587,7 @@ def main() -> int:
         metrics_text = fetch_text(base + "/v1/metrics")
         serving = serving_summary(metrics_text, status)
         req_tail = request_tail(base) if serving is not None else None
+        partitions = partition_rows(status, health)
         if args.json:
             # One-shot scripting mode (ISSUE 9 satellite): everything the
             # dashboard renders, as one JSON doc on stdout.
@@ -523,6 +600,7 @@ def main() -> int:
                 "trends": trends,
                 "serving": serving,
                 "request_tail": req_tail,
+                "partitions": partitions,
                 "rates": {
                     "tasks_per_sec": last_value(trends["tasks_per_sec"]),
                     "rows_per_sec": last_value(trends["rows_per_sec"]),
@@ -544,7 +622,8 @@ def main() -> int:
                 rate = max(0.0, (total - prev_tasks) / (now - prev_t))
             prev_tasks, prev_t = total, now
         frame = render(health, status, rate, colors, trends=trends,
-                       serving=serving, req_tail=req_tail)
+                       serving=serving, req_tail=req_tail,
+                       partitions=partitions)
         if args.once:
             sys.stdout.write(frame)
             return 0

@@ -201,6 +201,34 @@ class TestSessionSubmitter:
         with pytest.raises(RuntimeError):
             session_submitter(Session(500))(Arrival(0.0, cls, {}, 0))
 
+    def test_429_carries_router_partition_stamp(self):
+        """Behind the partition router (ISSUE 18) the 429 body names the
+        rejecting partition; the drop counts under that partition."""
+
+        class Session:
+            def post(self, url, json=None, timeout=None):
+                return TestSessionSubmitter._Resp(
+                    429, {"error": "queue full", "retry_after_ms": 500,
+                          "partition": "p2"},
+                )
+
+        cls = _classes()[1]
+        submit = session_submitter(Session(), "http://router")
+        with pytest.raises(Rejected) as exc:
+            submit(Arrival(0.0, cls, {}, 0))
+        assert exc.value.partition == "p2"
+
+        gen = LoadGen(_classes(), ArrivalPattern(20.0), seed=3)
+        clock = {"t": 0.0}
+        stats = gen.run(
+            submit, 1.0, now=lambda: clock["t"],
+            sleep=lambda s: clock.__setitem__("t", clock["t"] + s),
+        )
+        assert stats.total_rejected() > 0
+        assert stats.rejected_by_partition == {
+            "p2": stats.total_rejected()
+        }
+
     def test_loopback_round_trip(self):
         from agent_tpu.chaos import LoopbackSession
         from agent_tpu.controller.core import Controller

@@ -1,0 +1,596 @@
+"""Partitioned control plane (ISSUE 18): ring stability, routing core,
+steal policy, and the spool-redelivery exactly-once pins."""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+from agent_tpu.controller.core import Controller
+from agent_tpu.controller.partition import (
+    HashRing,
+    PartitionDown,
+    PartitionMap,
+    RouterCore,
+    job_id_for_partition,
+    placement_key,
+    stable_hash,
+)
+from agent_tpu.sched.steal import StealPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Ring stability
+# ---------------------------------------------------------------------------
+
+
+def test_stable_hash_is_not_builtin_hash():
+    # blake2b, not hash(): same value in every process regardless of
+    # PYTHONHASHSEED, and 64-bit wide.
+    v = stable_hash("tenant\x1fjob-1")
+    assert isinstance(v, int)
+    assert 0 <= v < 2**64
+    assert v == stable_hash("tenant\x1fjob-1")
+
+
+def test_placement_deterministic_across_processes():
+    """The whole point of stable_hash: a router replica, a restarted
+    router, and an agent-side partition map — different processes with
+    different hash seeds — must all place a key identically."""
+    keys = [placement_key(t, f"job-{i}")
+            for i in range(20) for t in (None, "acme")]
+    ring = HashRing(["p0", "p1", "p2"])
+    local = [ring.place(k) for k in keys]
+    code = (
+        "import json, sys\n"
+        "from agent_tpu.controller.partition import HashRing\n"
+        "ring = HashRing(['p0', 'p1', 'p2'])\n"
+        "keys = json.loads(sys.stdin.read())\n"
+        "print(json.dumps([ring.place(k) for k in keys]))\n"
+    )
+    for seed in ("0", "1", "31337"):
+        env = dict(os.environ, PYTHONHASHSEED=seed,
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        out = subprocess.run(
+            [sys.executable, "-c", code], input=json.dumps(keys),
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        assert json.loads(out.stdout) == local, (
+            f"placement diverged under PYTHONHASHSEED={seed}"
+        )
+
+
+def _remap_check(members, keys, slack=2.0):
+    """Rendezvous hashing's minimal-remap property on a concrete key set:
+    removing a member moves EXACTLY that member's keys (everyone else's
+    argmax is untouched), and adding one moves only keys it now wins —
+    ~1/N of them, bounded here by ``slack``/N."""
+    ring = HashRing(members)
+    n = len(members)
+    before = {k: ring.place(k) for k in keys}
+    victim = sorted(members)[0]
+
+    ring.remove(victim)
+    after_rm = {k: ring.place(k) for k in keys}
+    for k in keys:
+        if before[k] != victim:
+            assert after_rm[k] == before[k], (
+                f"{k!r} moved off a surviving member on remove"
+            )
+    owned = sum(1 for k in keys if before[k] == victim)
+    assert owned <= max(4, slack * len(keys) / n)
+
+    ring.add(victim)
+    after_add = {k: ring.place(k) for k in keys}
+    moved = [k for k in keys if after_add[k] != after_rm[k]]
+    for k in moved:
+        assert after_add[k] == victim, (
+            f"{k!r} moved on add but not onto the new member"
+        )
+    assert after_add == before  # add-back restores the exact placement
+    assert len(moved) <= max(4, slack * len(keys) / n)
+
+
+def test_ring_remap_bounded_seeded():
+    rng = random.Random(7)
+    members = [f"p{i}" for i in range(5)]
+    keys = [
+        placement_key(
+            rng.choice([None, "acme", "globex"]),
+            f"job-{rng.getrandbits(48):012x}",
+        )
+        for _ in range(2000)
+    ]
+    _remap_check(members, keys)
+
+
+def test_ring_remap_bounded_hypothesis():
+    hyp = pytest.importorskip("hypothesis")
+    st = pytest.importorskip("hypothesis.strategies")
+
+    @hyp.settings(deadline=None, max_examples=30)
+    @hyp.given(
+        n_members=st.integers(min_value=2, max_value=9),
+        seed=st.integers(min_value=0, max_value=2**31),
+        n_keys=st.integers(min_value=50, max_value=500),
+    )
+    def run(n_members, seed, n_keys):
+        rng = random.Random(seed)
+        members = [f"p{i}" for i in range(n_members)]
+        keys = list({
+            placement_key(None, f"job-{rng.getrandbits(48):012x}")
+            for _ in range(n_keys)
+        })
+        _remap_check(members, keys, slack=3.0)
+
+    run()
+
+
+def test_ring_rejects_bad_members():
+    with pytest.raises(ValueError):
+        HashRing([])
+    with pytest.raises(ValueError):
+        HashRing(["ok", "bad!name"])  # "!" is the lease-tag separator
+    ring = HashRing(["only"])
+    with pytest.raises(ValueError):
+        ring.remove("only")
+
+
+def test_job_id_for_partition_lands_where_asked():
+    ring = HashRing(["p0", "p1", "p2"])
+    for target in ring.members:
+        jid = job_id_for_partition(ring, target, prefix="t")
+        assert ring.place(placement_key(None, jid)) == target
+
+
+def test_partition_map_parse_grammar():
+    pmap = PartitionMap.parse(
+        "p0=http://a:1|http://a-standby:2, p1=http://b:3"
+    )
+    assert pmap.names == ("p0", "p1")
+    assert pmap.urls("p0") == ["http://a:1", "http://a-standby:2"]
+    bare = PartitionMap.parse("http://a:1,http://b:2")
+    assert bare.names == ("p0", "p1")
+    with pytest.raises(ValueError):
+        PartitionMap({"bad!": ["http://a"]})
+    with pytest.raises(ValueError):
+        PartitionMap({})
+
+
+# ---------------------------------------------------------------------------
+# Steal policy
+# ---------------------------------------------------------------------------
+
+
+def test_steal_policy_picks_deepest_eligible():
+    p = StealPolicy(enabled=True, min_advantage=2)
+    depths = {"home": 1, "a": 2, "b": 6, "c": 4}
+    assert p.pick_victim("home", depths) == "b"
+    # a is only +1 over home — under the hysteresis, never picked.
+    assert p.pick_victim("home", {"home": 1, "a": 2}) is None
+
+
+def test_steal_policy_skips_unknown_and_ties_by_name():
+    p = StealPolicy(enabled=True, min_advantage=1)
+    # Unreachable partitions sample as None and are never victims.
+    assert p.pick_victim("home", {"home": 0, "a": None}) is None
+    # Equal depths: first name in sorted order wins (deterministic).
+    assert p.pick_victim("home", {"home": 0, "b": 3, "a": 3}) == "a"
+    # A down HOME samples as None -> treated as depth 0, so any survivor
+    # with work qualifies — the partition-kill survivability hinge.
+    assert p.pick_victim("home", {"home": None, "a": 1}) == "a"
+
+
+def test_steal_policy_disabled_never_steals():
+    p = StealPolicy(enabled=False)
+    assert p.pick_victim("home", {"home": 0, "a": 100}) is None
+
+
+# ---------------------------------------------------------------------------
+# RouterCore over stub transports
+# ---------------------------------------------------------------------------
+
+
+class StubTransport:
+    """Scripted per-partition responses + a call log."""
+
+    def __init__(self, pmap, responses=None, depths=None):
+        self.pmap = pmap
+        self.responses = responses or {}
+        self.depths = depths or {}
+        self.down = set()
+        self.calls = []
+
+    def _name(self, url):
+        for name in self.pmap.names:
+            if url in self.pmap.urls(name):
+                return name
+        raise AssertionError(f"unknown url {url}")
+
+    def post(self, url, path, body, timeout):
+        name = self._name(url)
+        self.calls.append((name, url, path, body))
+        if name in self.down or url in self.down:
+            raise ConnectionError(f"{url} down")
+        fn = self.responses.get((name, path))
+        if fn is None:
+            return 200, {}
+        return fn(body)
+
+    def get(self, url, path, timeout):
+        name = self._name(url)
+        if name in self.down or url in self.down:
+            raise ConnectionError(f"{url} down")
+        if path == "/v1/depth":
+            return 200, {"leasable": self.depths.get(name, 0)}
+        return 404, None
+
+
+def make_core(names=("p0", "p1", "p2"), urls=None, **kwargs):
+    pmap = PartitionMap(
+        urls or {n: (f"http://{n}",) for n in names}
+    )
+    stub = StubTransport(pmap)
+    core = RouterCore(
+        pmap, stub.post, get_fn=stub.get,
+        steal=kwargs.pop("steal", StealPolicy(enabled=True,
+                                              min_advantage=1)),
+        depth_cache_sec=kwargs.pop("depth_cache_sec", 0.0),
+        **kwargs,
+    )
+    return core, stub
+
+
+def test_route_submit_mints_id_and_hits_home():
+    core, stub = make_core()
+    status, parsed = core.route_submit({"op": "echo", "payload": {}})
+    assert status == 200
+    (name, _, path, body) = stub.calls[0]
+    assert path == "/v1/jobs"
+    assert body["job_id"]  # router minted the id
+    assert name == core.home_for_job(None, body["job_id"])
+    # A client retry with the minted id lands on the same partition.
+    stub.calls.clear()
+    core.route_submit({"op": "echo", "job_id": body["job_id"]})
+    assert stub.calls[0][0] == name
+
+
+def test_route_submit_csv_places_whole_bulk_by_source_uri():
+    core, stub = make_core()
+    want = core.pmap.ring.place(
+        placement_key("acme", "csv\x1f/data/rows.csv")
+    )
+    for _ in range(3):
+        core.route_submit({
+            "source_uri": "/data/rows.csv", "tenant": "acme",
+            "total_rows": 100, "shard_size": 10,
+        })
+    assert [c[0] for c in stub.calls] == [want] * 3
+
+
+def test_route_submit_429_passes_through_with_partition_stamp():
+    core, stub = make_core()
+    jid = job_id_for_partition(core.pmap.ring, "p1", prefix="bp")
+    stub.responses[("p1", "/v1/jobs")] = lambda body: (
+        429, {"error": "queue full", "retry_after_ms": 1500}
+    )
+    status, parsed = core.route_submit({"op": "echo", "job_id": jid})
+    assert status == 429
+    assert parsed["retry_after_ms"] == 1500  # untouched
+    assert parsed["partition"] == "p1"       # who said no
+    assert core.counters["rejects_429_total"] == 1
+
+
+def test_route_lease_tags_and_route_result_untags():
+    core, stub = make_core()
+    agent = "worker-1"
+    home = core.home_for_agent(agent)
+    stub.responses[(home, "/v1/leases")] = lambda body: (
+        200, {"lease_id": "lease-abc", "tasks": [{"id": "j1"}]}
+    )
+    status, lease = core.route_lease({"agent": agent, "max_tasks": 1})
+    assert status == 200
+    assert lease["lease_id"] == f"{home}!lease-abc"
+    assert core.counters["lease_grants_home_total"] == 1
+
+    # The result follows the tag back and the partition sees its NATIVE id.
+    stub.calls.clear()
+    stub.responses[(home, "/v1/results")] = lambda body: (
+        200, {"accepted": True}
+    )
+    status, out = core.route_result({
+        "lease_id": lease["lease_id"], "job_id": "j1",
+        "job_epoch": 0, "status": "succeeded",
+    })
+    assert status == 200 and out["accepted"]
+    (name, _, path, body) = stub.calls[-1]
+    assert (name, path) == (home, "/v1/results")
+    assert body["lease_id"] == "lease-abc"
+    assert core.counters["results_routed_total"] == 1
+
+
+def test_route_lease_steals_from_deepest_when_home_empty():
+    core, stub = make_core()
+    agent = "worker-2"
+    home = core.home_for_agent(agent)
+    victim = next(n for n in core.pmap.names if n != home)
+    stub.depths.update({n: 0 for n in core.pmap.names})
+    stub.depths[victim] = 5
+    stub.responses[(home, "/v1/leases")] = lambda body: (204, None)
+    stub.responses[(victim, "/v1/leases")] = lambda body: (
+        200, {"lease_id": "lease-v", "tasks": [{"id": "j2"}]}
+    )
+    status, lease = core.route_lease({"agent": agent, "max_tasks": 1})
+    assert status == 200
+    assert lease["lease_id"] == f"{victim}!lease-v"
+    assert core.counters["lease_grants_stolen_total"] == 1
+
+
+def test_route_lease_home_down_falls_through_to_steal():
+    """A dead home partition must not strand its agents: the lease poll
+    falls through to stealing from a survivor with work."""
+    core, stub = make_core()
+    agent = "worker-3"
+    home = core.home_for_agent(agent)
+    victim = next(n for n in core.pmap.names if n != home)
+    stub.down.add(home)
+    stub.depths[victim] = 3
+    stub.responses[(victim, "/v1/leases")] = lambda body: (
+        200, {"lease_id": "lease-s", "tasks": [{"id": "j3"}]}
+    )
+    status, lease = core.route_lease({"agent": agent, "max_tasks": 1})
+    assert status == 200
+    assert lease["lease_id"].startswith(f"{victim}!")
+
+    # Heartbeat polls (max_tasks=0) must surface the outage instead —
+    # they carry metrics/spool flushes, not requests for work.
+    with pytest.raises(PartitionDown):
+        core.route_lease({"agent": agent, "max_tasks": 0})
+    # And with no victim holding work, the outage surfaces too.
+    stub.depths[victim] = 0
+    core.leasable_depths()  # refresh the (uncached) sample
+    with pytest.raises(PartitionDown):
+        core.route_lease({"agent": agent, "max_tasks": 1})
+
+
+def test_route_result_untagged_fans_out_until_owner_found():
+    core, stub = make_core()
+    owner = core.pmap.names[-1]
+    for n in core.pmap.names:
+        stub.responses[(n, "/v1/results")] = (
+            (lambda body: (200, {"accepted": True})) if n == owner
+            else (lambda body: (404, {"accepted": False,
+                                      "reason": "unknown job"}))
+        )
+    status, out = core.route_result({
+        "lease_id": "lease-legacy", "job_id": "jx", "job_epoch": 0,
+        "status": "succeeded",
+    })
+    assert status == 200 and out["accepted"]
+    assert core.counters["results_fanout_total"] == 1
+
+
+def test_route_result_untagged_unknown_plus_down_partition_raises():
+    """'Unknown job' while a partition is dark is NOT an answer — the
+    owner might be the dark one, so the agent must spool and retry."""
+    core, stub = make_core()
+    stub.down.add(core.pmap.names[0])
+    for n in core.pmap.names[1:]:
+        stub.responses[(n, "/v1/results")] = lambda body: (
+            404, {"accepted": False, "reason": "unknown job"}
+        )
+    with pytest.raises(PartitionDown):
+        core.route_result({
+            "lease_id": "lease-legacy", "job_id": "jy", "job_epoch": 0,
+            "status": "succeeded",
+        })
+
+
+def test_post_partition_rotates_to_standby_url():
+    core, stub = make_core(
+        names=("p0",),
+        urls={"p0": ("http://p0-primary", "http://p0-standby")},
+    )
+    stub.down.add("http://p0-primary")
+    stub.responses[("p0", "/v1/jobs")] = lambda body: (200, {"ok": True})
+    status, parsed = core.route_submit({"op": "echo", "job_id": "r1"})
+    assert status == 200
+    assert stub.calls[-1][1] == "http://p0-standby"
+    assert core.counters["partition_failovers_total"] == 1
+    # Both URLs dark -> PartitionDown names the partition.
+    stub.down.add("http://p0-standby")
+    with pytest.raises(PartitionDown) as exc:
+        core.route_submit({"op": "echo", "job_id": "r2"})
+    assert exc.value.partition == "p0"
+
+
+# ---------------------------------------------------------------------------
+# Spool redelivery + terminal guard, against REAL controllers
+# ---------------------------------------------------------------------------
+
+
+class ControllerFleet:
+    """Real in-process Controllers behind RouterCore's transport seam —
+    the /v1/jobs, /v1/leases, /v1/results, /v1/depth surface mapped
+    straight onto core calls, with a kill/restart switch per partition."""
+
+    def __init__(self, names, tmp):
+        self.tmp = tmp
+        self.journals = {
+            n: os.path.join(tmp, f"journal.{n}.jsonl") for n in names
+        }
+        self.controllers = {n: self._boot(n) for n in names}
+        self.down = set()
+        self.pmap = PartitionMap({n: (f"http://{n}",) for n in names})
+
+    def _boot(self, name):
+        return Controller(
+            partition=name, journal_path=self.journals[name],
+            lease_ttl_sec=30.0, requeue_delay_sec=0.0,
+        )
+
+    def kill(self, name):
+        # SIGKILL-shaped: no close(), the journal keeps the live lease.
+        self.down.add(name)
+
+    def restart(self, name):
+        self.controllers[name] = self._boot(name)
+        self.down.discard(name)
+
+    def close(self):
+        for c in self.controllers.values():
+            c.close()
+
+    def post(self, url, path, body, timeout):
+        name = url.removeprefix("http://")
+        if name in self.down:
+            raise ConnectionError(f"{name} is down")
+        c = self.controllers[name]
+        if path == "/v1/jobs":
+            jid = c.submit(
+                body["op"], body.get("payload"),
+                job_id=body.get("job_id"),
+            )
+            return 200, {"job_id": jid}
+        if path == "/v1/leases":
+            lease = c.lease(
+                str(body.get("agent")), body.get("capabilities"),
+                max_tasks=int(body.get("max_tasks", 1)),
+            )
+            return (204, None) if lease is None else (200, lease)
+        if path == "/v1/results":
+            out = c.report(
+                lease_id=str(body.get("lease_id", "")),
+                job_id=str(body.get("job_id", "")),
+                job_epoch=body.get("job_epoch"),
+                status=str(body.get("status", "")),
+                result=body.get("result"), error=body.get("error"),
+                # What the HTTP server bills: the measured request size.
+                wire_bytes=len(json.dumps(body).encode()),
+            )
+            return 200, out
+        return 404, None
+
+    def get(self, url, path, timeout):
+        name = url.removeprefix("http://")
+        if name in self.down:
+            raise ConnectionError(f"{name} is down")
+        if path == "/v1/depth":
+            return 200, {
+                "leasable": self.controllers[name].leasable_depth()
+            }
+        return 404, None
+
+
+def test_spool_redelivery_after_partition_death_bills_once(tmp_path):
+    """The ISSUE 18 regression pin: a result spooled against a partition
+    that died mid-lease redelivers to the restarted partition (journal
+    replay requeues the lease AT THE SAME EPOCH, so the redelivered
+    result is accepted, not stale-fenced) and bills exactly once; a late
+    duplicate then rejects on the terminal-state guard."""
+    fleet = ControllerFleet(["p0", "p1"], str(tmp_path))
+    try:
+        core = RouterCore(
+            fleet.pmap, fleet.post, get_fn=fleet.get,
+            steal=StealPolicy(enabled=True, min_advantage=1),
+            depth_cache_sec=0.0,
+        )
+        # An agent homed on p0 leases a p0-homed job through the router.
+        agent = next(
+            f"w{i}" for i in range(100)
+            if core.home_for_agent(f"w{i}") == "p0"
+        )
+        jid = job_id_for_partition(core.pmap.ring, "p0", prefix="sp")
+        status, _ = core.route_submit({
+            "op": "echo", "payload": {"x": 1}, "job_id": jid,
+        })
+        assert status == 200
+        status, lease = core.route_lease({
+            "agent": agent, "capabilities": {"ops": ["echo"]},
+            "max_tasks": 1,
+        })
+        assert status == 200
+        assert lease["lease_id"].startswith("p0!")
+        task = lease["tasks"][0]
+        assert task["id"] == jid
+
+        result_body = {
+            "lease_id": lease["lease_id"], "job_id": jid,
+            "job_epoch": task["job_epoch"], "status": "succeeded",
+            "result": {"x": 1},
+        }
+        # The partition dies before the result lands: the post raises,
+        # the agent spools the body — TAGGED lease id and all.
+        fleet.kill("p0")
+        with pytest.raises(PartitionDown):
+            core.route_result(result_body)
+
+        # Restart over the same journal; the spool flush retries the
+        # identical body and must be APPLIED (same epoch after replay).
+        fleet.restart("p0")
+        status, out = core.route_result(result_body)
+        assert status == 200 and out["accepted"], out
+        p0 = fleet.controllers["p0"]
+        assert p0.job_snapshot(jid)["state"] == "succeeded"
+        assert p0.usage is not None
+        assert p0.usage.job_billed_attempts().get(jid) == 1
+
+        # A late duplicate (redelivery raced a competing apply) rejects
+        # on the terminal guard and the bill does not move.
+        status, dup = core.route_result(result_body)
+        assert status == 200 and not dup["accepted"]
+        assert dup["reason"] == "already complete"
+        assert p0.usage.job_billed_attempts().get(jid) == 1
+    finally:
+        fleet.close()
+
+
+def test_stolen_lease_result_routes_to_owner_and_bills_once(tmp_path):
+    """A stolen lease is an ordinary lease against the job's OWNER: the
+    tagged id routes the thief's result to the victim partition, the
+    home partition never hears about it, and billing lands once."""
+    fleet = ControllerFleet(["p0", "p1"], str(tmp_path))
+    try:
+        core = RouterCore(
+            fleet.pmap, fleet.post, get_fn=fleet.get,
+            steal=StealPolicy(enabled=True, min_advantage=1),
+            depth_cache_sec=0.0,
+        )
+        # A thief homed on p1 steals p0's only job (p1 is empty).
+        thief = next(
+            f"t{i}" for i in range(100)
+            if core.home_for_agent(f"t{i}") == "p1"
+        )
+        jid = job_id_for_partition(core.pmap.ring, "p0", prefix="st")
+        core.route_submit({"op": "echo", "payload": {}, "job_id": jid})
+        status, lease = core.route_lease({
+            "agent": thief, "capabilities": {"ops": ["echo"]},
+            "max_tasks": 1,
+        })
+        assert status == 200
+        assert lease["lease_id"].startswith("p0!")  # granted by the owner
+        assert core.counters["lease_grants_stolen_total"] == 1
+
+        task = lease["tasks"][0]
+        status, out = core.route_result({
+            "lease_id": lease["lease_id"], "job_id": jid,
+            "job_epoch": task["job_epoch"], "status": "succeeded",
+            "result": {},
+        })
+        assert status == 200 and out["accepted"]
+        p0, p1 = fleet.controllers["p0"], fleet.controllers["p1"]
+        assert p0.job_snapshot(jid)["state"] == "succeeded"
+        with pytest.raises(KeyError):
+            p1.job_snapshot(jid)  # job state never moved partitions
+        assert p0.usage.job_billed_attempts().get(jid) == 1
+        assert (p1.usage.job_billed_attempts() if p1.usage else {}) == {}
+    finally:
+        fleet.close()
