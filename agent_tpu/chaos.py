@@ -139,6 +139,8 @@ def _path_of(url: str) -> str:
         return "results"
     if url.endswith("/v1/jobs"):
         return "jobs"
+    if url.endswith("/v1/workflows"):
+        return "workflows"
     return "other"
 
 
@@ -213,6 +215,31 @@ class LoopbackSession:
             except (KeyError, ValueError, TypeError) as exc:
                 return _FakeResponse(400, {"error": str(exc)})
             return _FakeResponse(200, {"job_id": job_id})
+        if path == "workflows":
+            # Workflow DAG submit (ISSUE 19) — same dispatch and error
+            # mapping as controller/server.py's POST /v1/workflows.
+            from agent_tpu.sched import AdmissionError
+
+            try:
+                out = self.controller.submit_workflow(
+                    workflow=body,
+                    tenant=body.get("tenant"),
+                    priority=body.get("priority"),
+                    deadline_sec=body.get("deadline_sec"),
+                    workflow_id=body.get("workflow_id"),
+                )
+            except AdmissionError as exc:
+                return _FakeResponse(429, {
+                    "error": str(exc),
+                    "retry_after_ms": exc.retry_after_ms,
+                    "tenant": exc.tenant,
+                    "scope": exc.scope,
+                })
+            except (KeyError, ValueError, TypeError) as exc:
+                return _FakeResponse(400, {"error": str(exc)})
+            except RuntimeError as exc:
+                return _FakeResponse(501, {"error": str(exc)})
+            return _FakeResponse(200, out)
         return _FakeResponse(404, {"error": f"no route {url}"})
 
 

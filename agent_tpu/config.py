@@ -791,6 +791,40 @@ class PartitionConfig:
 
 
 @dataclass(frozen=True)
+class FlowConfig:
+    """Workflow DAG engine + result cache knobs (ISSUE 19 — FLOW_*/CACHE_*).
+
+    The DAG limits bound what one ``POST /v1/workflows`` may expand into
+    (stages x fan-out, before admission control sees the jobs); the cache
+    knobs size the content-addressed result cache and pin the model
+    version that fences its key space (bump => invalidate)."""
+
+    enabled: bool = True                  # FLOW_ENABLED
+    max_stages: int = 32                  # FLOW_MAX_STAGES
+    max_width: int = 64                   # FLOW_MAX_WIDTH
+    cache_enabled: bool = True            # CACHE_ENABLED
+    cache_capacity: int = 4096            # CACHE_CAPACITY (entries; 0 = off)
+    cache_model_version: str = "v1"       # CACHE_MODEL_VERSION
+    # Billed est-cost per cache hit in the usage ledger — the "cache price"
+    # a deduped result charges instead of chip-seconds.
+    cache_price_per_hit: float = 0.0      # CACHE_PRICE_PER_HIT
+
+    @staticmethod
+    def from_env() -> "FlowConfig":
+        return FlowConfig(
+            enabled=env_bool("FLOW_ENABLED", True),
+            max_stages=max(1, env_int("FLOW_MAX_STAGES", 32)),
+            max_width=max(1, env_int("FLOW_MAX_WIDTH", 64)),
+            cache_enabled=env_bool("CACHE_ENABLED", True),
+            cache_capacity=max(0, env_int("CACHE_CAPACITY", 4096)),
+            cache_model_version=env_str("CACHE_MODEL_VERSION", "v1"),
+            cache_price_per_hit=max(
+                0.0, env_float("CACHE_PRICE_PER_HIT", 0.0)
+            ),
+        )
+
+
+@dataclass(frozen=True)
 class Config:
     """Aggregate, built once at process start and passed down explicitly."""
 
@@ -801,6 +835,7 @@ class Config:
     sched: SchedConfig = field(default_factory=SchedConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     partition: PartitionConfig = field(default_factory=PartitionConfig)
+    flow: FlowConfig = field(default_factory=FlowConfig)
 
     @staticmethod
     def from_env() -> "Config":
@@ -812,4 +847,5 @@ class Config:
             sched=SchedConfig.from_env(),
             serve=ServeConfig.from_env(),
             partition=PartitionConfig.from_env(),
+            flow=FlowConfig.from_env(),
         )

@@ -65,6 +65,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from agent_tpu.config import (
+    FlowConfig,
     JournalConfig,
     ObsConfig,
     TRUTHY_TOKENS,
@@ -73,6 +74,17 @@ from agent_tpu.config import (
     SloConfig,
 )
 from agent_tpu.controller.journal import SegmentedJournal
+from agent_tpu.flow.dag import (
+    DagError,
+    PlannedJob,
+    critical_path_lengths,
+    expand_workflow,
+    graph_doc,
+    parse_workflow,
+    spec_from_graph_doc,
+)
+from agent_tpu.flow.result_cache import ResultCache
+from agent_tpu.ops import OP_TO_MODULE, is_cacheable
 from agent_tpu.controller.serving import (
     DONE as SERVE_DONE,
     SERVE_OPS,
@@ -184,6 +196,21 @@ class Job:
     root_span_id: Optional[str] = None
     lease_span_id: Optional[str] = None
     enqueued_clock: float = 0.0
+    # Workflow DAG membership (ISSUE 19): stage jobs carry their graph id
+    # and stage name so status/placement/tracing see the whole DAG as one
+    # unit. ``critical_path`` is the longest remaining stage count to a
+    # sink — the scheduler's critical-path-first tiebreak (0 = plain job,
+    # which keeps non-DAG drain order bit-identical).
+    workflow_id: Optional[str] = None
+    stage: Optional[str] = None
+    critical_path: int = 0
+
+    @property
+    def trace_root(self) -> str:
+        """The trace this job's spans land in: its workflow's single tree
+        when it is a DAG stage, else its own job-id trace (ISSUE 19 —
+        one trace tree per DAG)."""
+        return self.workflow_id or self.job_id
 
     def to_task(self) -> Dict[str, Any]:
         task = {
@@ -207,7 +234,7 @@ class Job:
             # spans hang off the lease span. Absent when tracing is off,
             # keeping the wire byte-identical to the pre-trace protocol.
             task["trace"] = {
-                "trace_id": self.job_id,
+                "trace_id": self.trace_root,
                 "span_id": self.lease_span_id,
             }
         return task
@@ -232,6 +259,7 @@ class Controller:
         journal: Optional[JournalConfig] = None,
         serve: Optional[ServeConfig] = None,
         partition: Optional[str] = None,
+        flow: Optional[FlowConfig] = None,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
         # Partitioned control plane (ISSUE 18): this controller's partition
@@ -248,6 +276,18 @@ class Controller:
         self.max_attempts = max(1, int(max_attempts))
         self.requeue_delay_sec = max(0.0, float(requeue_delay_sec))
         self.sched_config = sched if sched is not None else SchedConfig()
+        # Workflow DAG engine + result cache (ISSUE 19). The cache is one
+        # shared instance serving both planes: batch jobs (submit/lease
+        # consult, report-time fill) and /v1/infer requests (front-door
+        # consult before bucketing).
+        self.flow_config = flow if flow is not None else FlowConfig()
+        self.result_cache: Optional[ResultCache] = None
+        if self.flow_config.cache_enabled \
+                and self.flow_config.cache_capacity > 0:
+            self.result_cache = ResultCache(
+                capacity=self.flow_config.cache_capacity,
+                model_version=self.flow_config.cache_model_version,
+            )
         self._clock = clock
         self._lock = threading.Lock()
         self._jobs: Dict[str, Job] = {}
@@ -407,6 +447,7 @@ class Controller:
                 top_k=self.obs_config.usage_top_k,
                 max_jobs=self.obs_config.usage_max_jobs,
                 cost_per_chip_hour=self.obs_config.usage_cost_per_chip_hour,
+                cache_price_per_hit=self.flow_config.cache_price_per_hit,
             )
         self.tsdb: Optional[TimeSeriesRing] = None
         if self.obs_config.tsdb_enabled:
@@ -516,6 +557,27 @@ class Controller:
         # Job ids some other job depends on (reduce stages): their result
         # bodies must survive a restart, so only these journal results.
         self._depended_on: Set[str] = set()
+        # Workflow DAG state (ISSUE 19): per-graph bookkeeping for status/
+        # tracing, job -> (workflow, stage) membership, and the REVERSE dep
+        # edges the generalized DependencyFailed cascade walks (forward
+        # edges live on Job.after; without the reverse map a failure would
+        # have to scan every job to find its dependents).
+        self._workflows: Dict[str, Dict[str, Any]] = {}
+        self._job_workflow: Dict[str, Tuple[str, str]] = {}
+        self._dependents: Dict[str, Set[str]] = {}
+        self._m_workflows = m.counter(
+            "flow_workflows_total",
+            "Workflow DAG submissions by outcome "
+            "(submitted/succeeded/dead/rejected)", ("outcome",))
+        self._m_flow_stage_jobs = m.counter(
+            "flow_stage_jobs_total",
+            "Jobs expanded out of workflow DAG stages", ("op",))
+        self._m_result_cache = m.counter(
+            "result_cache_events_total",
+            "Content-addressed result cache events by plane "
+            "(hit_submit/hit_lease/hit_infer = result served without "
+            "compute; miss = consulted, absent; put = computed result "
+            "stored)", ("event",))
         # Journal replay damage, distinctly visible to operators (ISSUE 10
         # satellite): a torn FINAL line (expected crash artifact, tolerated)
         # vs unparseable MID-FILE lines (real corruption). Mirrored from the
@@ -567,7 +629,7 @@ class Controller:
         if job is None or job.root_span_id is None:
             return
         self.traces.add({
-            "trace_id": job_id,
+            "trace_id": job.trace_root,
             "span_id": obs_trace.new_span_id(),
             "parent_span_id": job.root_span_id,
             "name": "sched.defer",
@@ -738,7 +800,7 @@ class Controller:
             after_order = tuple(ev.get("after") or ())
             raw_max = ev.get("max_attempts")
             raw_deadline = ev.get("deadline_sec")
-            self._jobs[ev["job_id"]] = Job(
+            job = Job(
                 job_id=ev["job_id"],
                 op=ev["op"],
                 payload=ev.get("payload") or {},
@@ -756,7 +818,40 @@ class Controller:
                 tenant=str(ev.get("tenant", DEFAULT_TENANT)),
                 deadline_sec=float(raw_deadline) if raw_deadline else None,
             )
+            # Workflow membership (ISSUE 19) replays from the ``workflow``
+            # event that preceded the stage submits in the journal — the
+            # submit record itself stays byte-identical to every prior
+            # schema.
+            info = self._job_workflow.get(job.job_id)
+            if info is not None:
+                job.workflow_id, job.stage = info
+                wf = self._workflows.get(job.workflow_id)
+                if wf is not None:
+                    job.critical_path = int(
+                        wf["critical_path"].get(job.stage, 0)
+                    )
+            for dep in after_order:
+                self._dependents.setdefault(dep, set()).add(job.job_id)
+            self._jobs[job.job_id] = job
             self._depended_on.update(after_order)
+        elif ev.get("ev") == "workflow":
+            # Graph bookkeeping rebuilds BEFORE the stage submits replay;
+            # the root span is recreated at finalize (traces are in-memory
+            # and did not survive).
+            self._register_workflow_locked(
+                str(ev.get("workflow_id")),
+                ev.get("graph") or {},
+                tenant=str(ev.get("tenant", DEFAULT_TENANT)),
+                priority=int(
+                    ev.get("priority", self.sched_config.default_priority)
+                ),
+                stage_jobs={
+                    str(k): list(v)
+                    for k, v in (ev.get("stage_jobs") or {}).items()
+                },
+                root_span_id=None,
+                now=self._clock(),
+            )
         elif ev.get("ev") == "result":
             job = self._jobs.get(ev.get("job_id"))
             if job is None:
@@ -766,6 +861,13 @@ class Controller:
             job.attempts = int(ev.get("attempts", job.attempts))
             job.result = ev.get("result")
             job.error = ev.get("error")
+            if ev.get("cache_hit"):
+                info = self._job_workflow.get(job.job_id)
+                wf = (
+                    self._workflows.get(info[0]) if info is not None else None
+                )
+                if wf is not None:
+                    wf["cache_hits"] += 1
             if self.usage is not None and isinstance(
                 ev.get("usage"), dict
             ):
@@ -793,6 +895,29 @@ class Controller:
         step reproduces exactly the scheduler order a full-history replay
         would have built. Results ride only for depended-on jobs — the
         same bound the journal's result events keep."""
+        for wrec in doc.get("workflows") or []:
+            # Workflow records load FIRST so job membership re-attaches
+            # while the job records stream in below.
+            if not isinstance(wrec, dict) or "workflow_id" not in wrec:
+                continue
+            self._register_workflow_locked(
+                str(wrec["workflow_id"]),
+                wrec.get("graph") or {},
+                tenant=str(wrec.get("tenant", DEFAULT_TENANT)),
+                priority=int(
+                    wrec.get(
+                        "priority", self.sched_config.default_priority
+                    )
+                ),
+                stage_jobs={
+                    str(k): list(v)
+                    for k, v in (wrec.get("stage_jobs") or {}).items()
+                },
+                root_span_id=None,
+                now=self._clock(),
+            )
+            wf = self._workflows[str(wrec["workflow_id"])]
+            wf["cache_hits"] = int(wrec.get("cache_hits", 0))
         for rec in doc.get("jobs") or []:
             after_order = tuple(rec.get("after") or ())
             raw_max = rec.get("max_attempts")
@@ -816,6 +941,16 @@ class Controller:
                 tenant=str(rec.get("tenant", DEFAULT_TENANT)),
                 deadline_sec=float(raw_deadline) if raw_deadline else None,
             )
+            info = self._job_workflow.get(job.job_id)
+            if info is not None:
+                job.workflow_id, job.stage = info
+                wfrec = self._workflows.get(job.workflow_id)
+                if wfrec is not None:
+                    job.critical_path = int(
+                        wfrec["critical_path"].get(job.stage, 0)
+                    )
+            for dep in after_order:
+                self._dependents.setdefault(dep, set()).add(job.job_id)
             self._jobs[job.job_id] = job
             self._depended_on.update(after_order)
         if self.usage is not None and isinstance(doc.get("usage"), dict):
@@ -834,6 +969,37 @@ class Controller:
         the second application (first wins) — never applied twice either
         way. Shared by restart replay and hot-standby promotion."""
         now = self._clock()
+        # Workflow progress recomputes from the replayed job states
+        # (ISSUE 19): counters fold whatever mix of snapshot + events got
+        # us here, and still-running graphs get a fresh root span so
+        # post-restart stage spans keep assembling into ONE tree.
+        for wf in self._workflows.values():
+            terminal = failed = 0
+            for ids in wf["stage_jobs"].values():
+                for jid in ids:
+                    job = self._jobs.get(jid)
+                    if job is None:
+                        # Retention-dropped terminal stage job: it only
+                        # left the snapshot because it was terminal.
+                        terminal += 1
+                        continue
+                    if job.state in TERMINAL_STATES:
+                        terminal += 1
+                        if job.state != SUCCEEDED:
+                            failed += 1
+            wf["terminal_jobs"] = terminal
+            wf["failed_jobs"] = failed
+            if terminal >= wf["total_jobs"]:
+                wf["state"] = "succeeded" if failed == 0 else "dead"
+            else:
+                wf["state"] = "running"
+                wf["root_span_id"] = self.traces.open(
+                    wf["workflow_id"], "workflow", start_clock=now,
+                    attributes={
+                        "replayed": True, "tenant": wf["tenant"],
+                        "stages": len(wf["stage_order"]),
+                    },
+                )
         for job in self._jobs.values():
             if job.state not in TERMINAL_STATES:
                 job.state = PENDING
@@ -844,13 +1010,45 @@ class Controller:
                 job.enqueued_clock = now
                 # Traces are in-memory and did not survive the restart: a
                 # fresh root span lets post-restart spans still assemble.
+                parent_span = None
+                if job.workflow_id is not None:
+                    wf = self._workflows.get(job.workflow_id)
+                    parent_span = (wf or {}).get("root_span_id")
                 job.root_span_id = self.traces.open(
-                    job.job_id, "submit", start_clock=now,
+                    job.trace_root, "submit", parent_span_id=parent_span,
+                    start_clock=now,
                     attributes={"op": job.op, "replayed": True},
                 )
                 self._sched.add(job)
                 if job.deadline_sec is not None:
                     self._deadlined.add(job.job_id)
+            elif (
+                self.result_cache is not None
+                and job.state == SUCCEEDED
+                and not job.after_order
+                and isinstance(job.result, dict)
+                and is_cacheable(job.op)
+            ):
+                # Warm the result cache from replayed dep-free results: a
+                # restart must not forfeit the dedupe it already earned.
+                # (Dep-gated jobs are skipped — their cache key covers the
+                # lease-time materialized partials, not the submit
+                # payload.)
+                self.result_cache.put(job.op, job.payload, job.result)
+        # Replay-ordering re-arm/cascade fix (ISSUE 19 satellite): a
+        # dep-gated job is requeued above in whatever state its upstreams
+        # REPLAYED to, which can differ from the order things happened
+        # live — an upstream that went terminal between the downstream's
+        # submit record and the journal tail. Success re-arms for free
+        # (dep checks read live state at lease time), but a FAILED/DEAD
+        # upstream used to strand the dependent in pending forever: the
+        # only cascade ran inside ``_serve_reap`` and touched serve jobs
+        # alone. Walk the general cascade for every replayed failure so
+        # batch/DAG dependents die (and journal) the same way live ones
+        # do.
+        for job in list(self._jobs.values()):
+            if job.state in (FAILED, DEAD):
+                self._cascade_dep_failure_locked(job, now)
         self._update_queue_stats_locked(now)
 
     def _replay_journal(self, impl: SegmentedJournal) -> None:
@@ -935,10 +1133,30 @@ class Controller:
                 "tenant": job.tenant,
                 "deadline_sec": job.deadline_sec,
             }
-            if job.job_id in self._depended_on:
+            if (
+                job.job_id in self._depended_on
+                or job.workflow_id is not None
+            ):
                 rec["result"] = job.result
             jobs.append(rec)
         state: Dict[str, Any] = {"jobs": jobs}
+        if self._workflows:
+            # Workflow graphs ride the snapshot (ISSUE 19) the same way
+            # the ``workflow`` journal event rides the segments: replay
+            # re-attaches stage-job membership from them. Progress
+            # counters recompute from job states at finalize; only the
+            # cache-hit count (not derivable from state) is carried.
+            state["workflows"] = [
+                {
+                    "workflow_id": wf["workflow_id"],
+                    "tenant": wf["tenant"],
+                    "priority": wf["priority"],
+                    "graph": wf["graph"],
+                    "stage_jobs": wf["stage_jobs"],
+                    "cache_hits": wf["cache_hits"],
+                }
+                for wf in self._workflows.values()
+            ]
         if drop:
             state["dropped_terminal"] = len(drop)
         if self.usage is not None:
@@ -1179,7 +1397,17 @@ class Controller:
         priority: Optional[int] = None,
         tenant: Optional[str] = None,
         deadline_sec: Optional[float] = None,
+        workflow_id: Optional[str] = None,
+        stage: Optional[str] = None,
+        critical_path: int = 0,
     ) -> str:
+        """Submit one job. The trailing workflow kwargs are internal —
+        ``submit_workflow`` stamps DAG membership (graph id, stage name,
+        remaining-critical-path length) onto the stage jobs it expands;
+        they are deliberately NOT journaled on the submit record (the
+        ``workflow`` journal event carries the graph once, and replay
+        re-attaches membership from it), keeping plain submit bytes
+        identical to every prior journal schema."""
         job_id = job_id or f"job-{self._id_tag}{uuid.uuid4().hex[:12]}"
         if priority is not None:
             if (
@@ -1254,26 +1482,65 @@ class Controller:
             deadline_sec=(
                 float(deadline_sec) if deadline_sec is not None else None
             ),
+            workflow_id=workflow_id,
+            stage=stage,
+            critical_path=max(0, int(critical_path)),
         )
+        # Submit-time result-cache consult (ISSUE 19): a dep-free cacheable
+        # WORKFLOW STAGE whose content key already has a stored result
+        # never enters the queue — it lands terminal SUCCEEDED with the
+        # cached bytes. Dep-gated stages consult at lease time instead
+        # (their real input includes the partials that don't exist yet).
+        # Plain ``POST /v1/jobs`` submits never consult: every non-DAG
+        # submit executes, the contract the pre-DAG controller pinned
+        # (test_sched's FIFO model, fault injection, standby promotion all
+        # count on submitted == executed). The lookup runs outside the
+        # controller lock (the cache has its own).
+        cached_result: Optional[Dict[str, Any]] = None
+        if (
+            self.result_cache is not None
+            and not after_order
+            and workflow_id is not None
+            and is_cacheable(op)
+        ):
+            cached_result = self.result_cache.get(op, job.payload)
+            if cached_result is None:
+                self._m_result_cache.inc(event="miss")
         with self._lock:
             if job_id in self._jobs:
                 raise ValueError(f"duplicate job id {job_id!r}")
-            self._admit_locked(job.tenant)
+            if cached_result is None:
+                # A cache hit consumes no queue slot — admission control
+                # guards the pending budget, and a hit never goes pending.
+                self._admit_locked(job.tenant)
             now = self._clock()
             job.submitted_at = now
             job.enqueued_clock = now
             # Root of the job's span tree (ISSUE 5): open at submit, closed
-            # when the job reaches a terminal state. trace_id = job_id.
+            # when the job reaches a terminal state. trace_id = job_id —
+            # except workflow stage jobs (ISSUE 19), whose spans parent to
+            # the workflow's root so the whole DAG is ONE trace tree.
+            span_attrs: Dict[str, Any] = {
+                "op": op, "tenant": job.tenant, "priority": job.priority,
+            }
+            parent_span = None
+            if workflow_id is not None:
+                wf = self._workflows.get(workflow_id)
+                parent_span = (wf or {}).get("root_span_id")
+                span_attrs["stage"] = stage
             job.root_span_id = self.traces.open(
-                job_id, "submit", start_clock=now,
-                attributes={
-                    "op": op, "tenant": job.tenant, "priority": job.priority,
-                },
+                job.trace_root, "submit", parent_span_id=parent_span,
+                start_clock=now, attributes=span_attrs,
             )
             self._jobs[job_id] = job
-            self._sched.add(job)
-            if job.deadline_sec is not None:
-                self._deadlined.add(job_id)
+            for dep in after_order:
+                # Reverse dependency edges: what the generalized
+                # DependencyFailed cascade walks (ISSUE 19).
+                self._dependents.setdefault(dep, set()).add(job_id)
+            if cached_result is None:
+                self._sched.add(job)
+                if job.deadline_sec is not None:
+                    self._deadlined.add(job_id)
             self._update_queue_stats_locked(now)
             self.recorder.record("submit", job_id=job_id, op=op)
             self._depended_on.update(after_order)
@@ -1297,6 +1564,12 @@ class Controller:
             if deadline_sec is not None:
                 record["deadline_sec"] = job.deadline_sec
             self._journal(record)
+            if cached_result is not None:
+                # Terminal immediately: the submit record above plus the
+                # cache-hit result record replay back to the same state.
+                self._finalize_cache_hit_locked(
+                    job, cached_result, now, plane="submit"
+                )
         return job_id
 
     def suggested_shard_size(self) -> Optional[int]:
@@ -1398,6 +1671,406 @@ class Controller:
             )
         return shard_ids, reduce_id
 
+    # ---- workflow DAG engine + result cache (ISSUE 19) ----
+
+    def submit_workflow(
+        self,
+        workflow: Dict[str, Any],
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+        deadline_sec: Optional[float] = None,
+        workflow_id: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """``POST /v1/workflows``: accept a fan-out/fan-in graph as ONE
+        unit, validate it (acyclic, known ops, bounded width — ``DagError``
+        maps to HTTP 400), expand stages into ordinary jobs with
+        generalized dep edges, and journal the graph FIRST so replay and
+        standby promotion rebuild membership before any stage submit
+        replays. Returns ``{workflow_id, job_ids, stages}``."""
+        if not self.flow_config.enabled:
+            raise RuntimeError("workflows are disabled (FLOW_ENABLED=0)")
+        spec = parse_workflow(
+            workflow,
+            known_ops=list(OP_TO_MODULE),
+            max_stages=self.flow_config.max_stages,
+            max_width=self.flow_config.max_width,
+        )
+        if priority is not None and (
+            isinstance(priority, bool) or not isinstance(priority, int)
+            or not PRIORITY_MIN <= priority <= PRIORITY_MAX
+        ):
+            raise ValueError(
+                f"priority must be an int in [{PRIORITY_MIN}, "
+                f"{PRIORITY_MAX}], got {priority!r}"
+            )
+        if tenant is not None and (
+            not isinstance(tenant, str) or not tenant
+        ):
+            raise ValueError(
+                f"tenant must be a non-empty string, got {tenant!r}"
+            )
+        if workflow_id is not None and (
+            not isinstance(workflow_id, str) or not workflow_id
+        ):
+            raise ValueError("workflow_id must be a non-empty string")
+        workflow_id = (
+            workflow_id or f"wf-{self._id_tag}{uuid.uuid4().hex[:12]}"
+        )
+        default_priority = (
+            priority if priority is not None
+            else self.sched_config.default_priority
+        )
+        tenant_val = tenant if tenant is not None else DEFAULT_TENANT
+        planned = expand_workflow(
+            spec, workflow_id, default_priority=default_priority
+        )
+        graph = graph_doc(spec)
+        stage_jobs: Dict[str, List[str]] = {}
+        for pj in planned:
+            stage_jobs.setdefault(pj.stage, []).append(pj.job_id)
+        with self._lock:
+            if workflow_id in self._workflows:
+                raise ValueError(f"duplicate workflow id {workflow_id!r}")
+            if any(pj.job_id in self._jobs for pj in planned):
+                raise ValueError(
+                    f"workflow {workflow_id!r} stage job ids collide with "
+                    "existing jobs"
+                )
+            # Whole-graph admission pre-check (the CSV rule): reject before
+            # the first stage submits rather than 429 mid-expansion.
+            self._admit_locked(tenant_val, len(planned))
+            now = self._clock()
+            root_span = self.traces.open(
+                workflow_id, "workflow", start_clock=now,
+                attributes={
+                    "stages": len(spec.stages), "jobs": len(planned),
+                    "tenant": tenant_val, "priority": default_priority,
+                },
+            )
+            self._register_workflow_locked(
+                workflow_id, graph, tenant_val, default_priority,
+                stage_jobs, root_span_id=root_span, now=now,
+            )
+            self._m_workflows.inc(outcome="submitted")
+            self.recorder.record(
+                "workflow_submit", workflow_id=workflow_id,
+                stages=len(spec.stages), jobs=len(planned),
+            )
+            self._journal({
+                "ev": "workflow",
+                "workflow_id": workflow_id,
+                "tenant": tenant_val,
+                "priority": default_priority,
+                "graph": graph,
+                "stage_jobs": stage_jobs,
+            })
+        job_ids: List[str] = []
+        for pj in planned:
+            job_ids.append(self.submit(
+                pj.op,
+                pj.payload,
+                job_id=pj.job_id,
+                after=list(pj.after),
+                required_labels=pj.required_labels,
+                max_attempts=pj.max_attempts,
+                priority=pj.priority,
+                tenant=tenant,
+                deadline_sec=deadline_sec,
+                workflow_id=workflow_id,
+                stage=pj.stage,
+                critical_path=pj.critical_path,
+            ))
+            self._m_flow_stage_jobs.inc(op=pj.op)
+        return {
+            "workflow_id": workflow_id,
+            "job_ids": job_ids,
+            "stages": [s.name for s in spec.stages],
+        }
+
+    def _register_workflow_locked(
+        self,
+        workflow_id: str,
+        graph: Dict[str, Any],
+        tenant: str,
+        priority: int,
+        stage_jobs: Dict[str, List[str]],
+        root_span_id: Optional[str],
+        now: float,
+    ) -> None:
+        """Install the per-graph bookkeeping record + job membership map.
+        Shared by live submit and journal replay (the ``workflow`` event)."""
+        spec = spec_from_graph_doc(graph)
+        cp = critical_path_lengths(spec)
+        total = sum(len(ids) for ids in stage_jobs.values())
+        self._workflows[workflow_id] = {
+            "workflow_id": workflow_id,
+            "tenant": tenant,
+            "priority": priority,
+            "graph": graph,
+            "stage_jobs": {k: list(v) for k, v in stage_jobs.items()},
+            "stage_order": [s.name for s in spec.stages],
+            "critical_path": cp,
+            "total_jobs": total,
+            "terminal_jobs": 0,
+            "failed_jobs": 0,
+            "cache_hits": 0,
+            "state": "running",
+            "root_span_id": root_span_id,
+            "submitted_clock": now,
+            "submitted_wall": time.time(),
+        }
+        for stage, ids in stage_jobs.items():
+            for jid in ids:
+                self._job_workflow[jid] = (workflow_id, stage)
+
+    def _workflow_note_terminal_locked(self, job: Job, now: float) -> None:
+        """Progress accounting on any stage job reaching a terminal state.
+        When the last stage job lands, the workflow itself goes terminal:
+        root span finished (closing the single DAG trace tree), outcome
+        counted, recorder event."""
+        info = self._job_workflow.get(job.job_id)
+        if info is None:
+            return
+        wf = self._workflows.get(info[0])
+        if wf is None or wf["state"] != "running":
+            return
+        wf["terminal_jobs"] += 1
+        if job.state != SUCCEEDED:
+            wf["failed_jobs"] += 1
+        if wf["terminal_jobs"] < wf["total_jobs"]:
+            return
+        wf["state"] = "succeeded" if wf["failed_jobs"] == 0 else "dead"
+        wf["finished_clock"] = now
+        self.traces.finish(
+            wf["workflow_id"], wf.get("root_span_id"), now,
+            attributes={
+                "outcome": wf["state"], "failed_jobs": wf["failed_jobs"],
+                "cache_hits": wf["cache_hits"],
+            },
+        )
+        self._m_workflows.inc(outcome=wf["state"])
+        self.recorder.record(
+            "workflow_done", workflow_id=wf["workflow_id"],
+            outcome=wf["state"], failed_jobs=wf["failed_jobs"],
+            cache_hits=wf["cache_hits"],
+        )
+
+    def _cascade_dep_failure_locked(self, failed: Job, now: float) -> None:
+        """Generalized DependencyFailed cascade (ISSUE 19): walk the
+        REVERSE dep edges from a terminally-failed job and kill every
+        still-pending dependent, transitively — a workflow's downstream
+        stages must not sit queued forever behind a dead upstream. This
+        supersedes the serve-only scan ``_serve_reap`` used to carry (that
+        path now rides the same edges). Each death journals as a result
+        record so replay keeps it dead.
+
+        Scope: workflow members and serve-door jobs (the two populations
+        with a waiter who must see the failure). Plain dep-gated jobs keep
+        the legacy contract — a dead upstream leaves them pending, the
+        behavior the pre-DAG controller pinned (test_sched's FIFO model
+        replays interleavings against it byte-for-byte)."""
+        if failed.state not in (FAILED, DEAD):
+            return
+        serve_ids = (
+            set(self.serve_door.job_ids())
+            if self.serve_door is not None else set()
+        )
+        stack = [failed.job_id]
+        while stack:
+            dead_id = stack.pop()
+            for dep_id in sorted(self._dependents.get(dead_id, ())):
+                job = self._jobs.get(dep_id)
+                if job is None or job.state != PENDING:
+                    continue
+                if job.workflow_id is None and dep_id not in serve_ids:
+                    continue
+                self._sched.discard(dep_id)
+                self._delayed.discard(dep_id)
+                self._deadlined.discard(dep_id)
+                job.error = {
+                    "type": "DependencyFailed",
+                    "message": f"dependency {dead_id} failed",
+                    "trace": "",
+                }
+                job.state = DEAD
+                self.traces.finish(
+                    job.trace_root, job.root_span_id, now,
+                    attributes={
+                        "outcome": DEAD, "reason": "DependencyFailed",
+                    },
+                )
+                self._slo_observe_locked(job, now)
+                self._m_dead.inc(op=job.op)
+                self.recorder.record(
+                    "dead", job_id=dep_id, op=job.op,
+                    reason="dependency", attempts=job.attempts,
+                )
+                self._journal({
+                    "ev": "result",
+                    "job_id": dep_id,
+                    "state": DEAD,
+                    "epoch": job.epoch,
+                    "attempts": job.attempts,
+                    "result": None,
+                    "error": job.error,
+                })
+                self._workflow_note_terminal_locked(job, now)
+                stack.append(dep_id)
+        self._update_queue_stats_locked(now)
+
+    def _finalize_cache_hit_locked(
+        self, job: Job, result: Dict[str, Any], now: float, plane: str
+    ) -> None:
+        """Land a result-cache hit as a terminal SUCCEEDED application:
+        journaled as a cache-hit result event (with the result BODY —
+        downstream stages and replay must see the exact cached bytes),
+        billed at cache price in the usage ledger, root span closed with
+        the hit attribute, workflow progress noted. Caller holds the lock
+        and has kept the job out of (or removed it from) the queue."""
+        job.result = result
+        job.error = None
+        job.state = SUCCEEDED
+        self._delayed.discard(job.job_id)
+        self._deadlined.discard(job.job_id)
+        self._m_result_cache.inc(event=f"hit_{plane}")
+        self.recorder.record(
+            "cache_hit", job_id=job.job_id, op=job.op, plane=plane,
+        )
+        if job.lease_span_id is not None:
+            self.traces.finish(
+                job.trace_root, job.lease_span_id, now,
+                attributes={"outcome": SUCCEEDED, "cache_hit": True},
+            )
+            job.lease_span_id = None
+        self.traces.finish(
+            job.trace_root, job.root_span_id, now,
+            attributes={"outcome": SUCCEEDED, "cache_hit": True},
+        )
+        self._slo_observe_locked(job, now)
+        billed = None
+        if self.usage is not None:
+            billed = self.usage.bill(
+                job.job_id, tenant=job.tenant, tier=job.priority,
+                op=job.op, attempt=job.attempts,
+                usage={"result_cache_hits": 1},
+            )
+        record: Dict[str, Any] = {
+            "ev": "result",
+            "job_id": job.job_id,
+            "state": SUCCEEDED,
+            "epoch": job.epoch,
+            "attempts": job.attempts,
+            "result": job.result,
+            "error": None,
+            "cache_hit": True,
+        }
+        if billed is not None:
+            record["usage"] = billed
+        self._journal(record)
+        info = self._job_workflow.get(job.job_id)
+        if info is not None:
+            wf = self._workflows.get(info[0])
+            if wf is not None:
+                wf["cache_hits"] += 1
+        self._workflow_note_terminal_locked(job, now)
+
+    def workflow_json(self, workflow_id: str) -> Optional[Dict[str, Any]]:
+        """``GET /v1/workflows/{id}``: graph + per-stage progress + the
+        critical-path stage (deepest remaining work — what the scheduler
+        is preferring right now) + terminal results of the sink stages."""
+        with self._lock:
+            wf = self._workflows.get(workflow_id)
+            if wf is None:
+                return None
+            stages = []
+            critical_stage = None
+            critical_depth = -1
+            for stage in wf["stage_order"]:
+                ids = wf["stage_jobs"].get(stage, [])
+                counts: Dict[str, int] = {}
+                for jid in ids:
+                    job = self._jobs.get(jid)
+                    state = job.state if job is not None else "forgotten"
+                    counts[state] = counts.get(state, 0) + 1
+                remaining = sum(
+                    n for s, n in counts.items()
+                    if s not in TERMINAL_STATES
+                )
+                depth = int(wf["critical_path"].get(stage, 0))
+                if remaining and depth > critical_depth:
+                    critical_depth = depth
+                    critical_stage = stage
+                stages.append({
+                    "name": stage,
+                    "jobs": len(ids),
+                    "counts": counts,
+                    "critical_path": depth,
+                })
+            # Sink results: stages nothing depends on (fan-in outputs).
+            downstream: Set[str] = set()
+            for raw in wf["graph"].get("stages", []):
+                downstream.update(raw.get("after") or ())
+            results: Dict[str, Any] = {}
+            for stage in wf["stage_order"]:
+                if stage in downstream:
+                    continue
+                for jid in wf["stage_jobs"].get(stage, []):
+                    job = self._jobs.get(jid)
+                    if job is not None and job.state == SUCCEEDED:
+                        results[jid] = job.result
+            out = {
+                "workflow_id": workflow_id,
+                "tenant": wf["tenant"],
+                "priority": wf["priority"],
+                "state": wf["state"],
+                "stages": stages,
+                "total_jobs": wf["total_jobs"],
+                "terminal_jobs": wf["terminal_jobs"],
+                "failed_jobs": wf["failed_jobs"],
+                "cache_hits": wf["cache_hits"],
+                "critical_stage": critical_stage,
+                "submitted_wall": round(wf["submitted_wall"], 3),
+                "results": results,
+            }
+            if self.partition:
+                out["partition"] = self.partition
+            return out
+
+    def workflows_json(self) -> Dict[str, Any]:
+        """Summary list for swarmtop's Workflows panel + ``--json``."""
+        with self._lock:
+            items = []
+            for wf in self._workflows.values():
+                done = wf["terminal_jobs"]
+                critical_stage = None
+                critical_depth = -1
+                for stage in wf["stage_order"]:
+                    remaining = sum(
+                        1 for jid in wf["stage_jobs"].get(stage, [])
+                        if (j := self._jobs.get(jid)) is not None
+                        and j.state not in TERMINAL_STATES
+                    )
+                    depth = int(wf["critical_path"].get(stage, 0))
+                    if remaining and depth > critical_depth:
+                        critical_depth = depth
+                        critical_stage = stage
+                items.append({
+                    "workflow_id": wf["workflow_id"],
+                    "tenant": wf["tenant"],
+                    "state": wf["state"],
+                    "stages": len(wf["stage_order"]),
+                    "total_jobs": wf["total_jobs"],
+                    "terminal_jobs": done,
+                    "failed_jobs": wf["failed_jobs"],
+                    "cache_hits": wf["cache_hits"],
+                    "critical_stage": critical_stage,
+                })
+            cache = (
+                self.result_cache.stats()
+                if self.result_cache is not None else None
+            )
+        return {"workflows": items, "result_cache": cache}
+
     # ---- fault injection (SURVEY.md §5.3, extended by ISSUE 3) ----
 
     def inject(self, fault: Optional[str] = None, plan: Any = None) -> None:
@@ -1433,7 +2106,7 @@ class Controller:
                 job.state = PENDING
                 job.lease_id = None
                 self.traces.finish(
-                    job.job_id, job.lease_span_id, now,
+                    job.trace_root, job.lease_span_id, now,
                     attributes={"outcome": "expired"},
                 )
                 job.lease_span_id = None
@@ -1483,7 +2156,7 @@ class Controller:
                 }
                 job.state = DEAD
                 self.traces.finish(
-                    job.job_id, job.root_span_id, now,
+                    job.trace_root, job.root_span_id, now,
                     attributes={"outcome": DEAD, "reason": "DeadlineExceeded"},
                 )
                 # A deadline death is an availability breach the SLO engine
@@ -1510,6 +2183,11 @@ class Controller:
                         "error": job.error,
                     }
                 )
+                # A deadline death inside a DAG fails every downstream stage
+                # (ISSUE 19) — after journaling the death itself, so replay
+                # sees cause before effect.
+                self._workflow_note_terminal_locked(job, now)
+                self._cascade_dep_failure_locked(job, now)
             elif not job.escalated and age >= job.deadline_sec * frac:
                 job.escalated = True
                 if job.priority < PRIORITY_MAX:
@@ -1756,105 +2434,142 @@ class Controller:
                 mesh_devices=caps.get("mesh_devices"),
                 queue_depth=caps.get("queue_depth"),
             )
-            for job in self._sched.take(ctx, eligible):
-                job.state = LEASED
-                job.lease_id = lease_id
-                job.lease_deadline = deadline
-                job.agent = agent
-                job.attempts += 1
-                self._m_tasks_leased.inc(op=job.op)
-                self._m_sched_decisions.inc(
-                    policy=self.sched_config.policy, decision="leased")
-                if job.attempts == 1:
-                    # Queue-wait attribution: submit → FIRST lease only
-                    # (a retry's wait measures failure handling, not
-                    # scheduling pressure).
-                    self._m_queue_wait.observe(
-                        max(0.0, now - job.submitted_at),
-                        exemplar={"trace_id": job.job_id},
-                        op=job.op,
+            while True:
+                cache_hits_round = 0
+                for job in self._sched.take(ctx, eligible):
+                    if (
+                        self.result_cache is not None
+                        and job.workflow_id is not None
+                        and is_cacheable(job.op)
+                    ):
+                        # Lease-time result-cache consult (ISSUE 19): the
+                        # first moment a dep-gated stage's REAL input exists
+                        # — materialize its partials, then key on the full
+                        # payload. A hit lands terminal here without ever
+                        # reaching an agent (the job already left the queue
+                        # via take()). Dep-free cacheable stages re-consult
+                        # too: an identical job may have computed while this
+                        # one sat queued. Workflow stages only — plain jobs
+                        # keep the submitted == executed contract.
+                        if job.payload.pop("__collect_partials__", None):
+                            job.payload["partials"] = [
+                                self._jobs[d].result
+                                for d in job.after_order
+                                if d in self._jobs
+                            ]
+                        cached = self.result_cache.get(job.op, job.payload)
+                        if cached is not None:
+                            self._finalize_cache_hit_locked(
+                                job, cached, now, plane="lease"
+                            )
+                            cache_hits_round += 1
+                            continue
+                        self._m_result_cache.inc(event="miss")
+                    job.state = LEASED
+                    job.lease_id = lease_id
+                    job.lease_deadline = deadline
+                    job.agent = agent
+                    job.attempts += 1
+                    self._m_tasks_leased.inc(op=job.op)
+                    self._m_sched_decisions.inc(
+                        policy=self.sched_config.policy, decision="leased")
+                    if job.attempts == 1:
+                        # Queue-wait attribution: submit → FIRST lease only
+                        # (a retry's wait measures failure handling, not
+                        # scheduling pressure).
+                        self._m_queue_wait.observe(
+                            max(0.0, now - job.submitted_at),
+                            exemplar={"trace_id": job.trace_root},
+                            op=job.op,
+                        )
+                        self._m_starvation.observe(
+                            max(0.0, now - job.submitted_at), tenant=job.tenant
+                        )
+                    if job.root_span_id is not None:
+                        # The scheduling wait as a span: last-enqueued → this
+                        # grant, annotated with the policy's deferral/held
+                        # history so "why did this job sit" reads off the trace.
+                        wait = max(0.0, now - job.enqueued_clock)
+                        self.traces.add({
+                            "trace_id": job.trace_root,
+                            "span_id": obs_trace.new_span_id(),
+                            "parent_span_id": job.root_span_id,
+                            "name": "sched.decide",
+                            "start_wall": time.time() - wait,
+                            "start_mono": job.enqueued_clock,
+                            "duration_ms": round(wait * 1e3, 3),
+                            "process": "controller",
+                            "attributes": {
+                                "decision": "leased",
+                                "policy": self.sched_config.policy,
+                                "attempt": job.attempts,
+                                "placement_defers": job.placement_defers,
+                                "held": job.not_before > job.enqueued_clock,
+                                "agent": agent,
+                            },
+                        })
+                        # The lease window stays open until the result applies
+                        # or the TTL expires; agent-side spans parent to it.
+                        job.lease_span_id = self.traces.open(
+                            job.trace_root, "lease",
+                            parent_span_id=job.root_span_id, start_clock=now,
+                            attributes={
+                                "lease_id": lease_id, "agent": agent,
+                                "epoch": job.epoch, "attempt": job.attempts,
+                            },
+                        )
+                    self.recorder.record(
+                        "lease", job_id=job.job_id, op=job.op,
+                        lease_id=lease_id, agent=agent, epoch=job.epoch,
+                        attempt=job.attempts,
                     )
-                    self._m_starvation.observe(
-                        max(0.0, now - job.submitted_at), tenant=job.tenant
-                    )
-                if job.root_span_id is not None:
-                    # The scheduling wait as a span: last-enqueued → this
-                    # grant, annotated with the policy's deferral/held
-                    # history so "why did this job sit" reads off the trace.
-                    wait = max(0.0, now - job.enqueued_clock)
-                    self.traces.add({
-                        "trace_id": job.job_id,
-                        "span_id": obs_trace.new_span_id(),
-                        "parent_span_id": job.root_span_id,
-                        "name": "sched.decide",
-                        "start_wall": time.time() - wait,
-                        "start_mono": job.enqueued_clock,
-                        "duration_ms": round(wait * 1e3, 3),
-                        "process": "controller",
-                        "attributes": {
-                            "decision": "leased",
-                            "policy": self.sched_config.policy,
-                            "attempt": job.attempts,
-                            "placement_defers": job.placement_defers,
-                            "held": job.not_before > job.enqueued_clock,
-                            "agent": agent,
-                        },
-                    })
-                    # The lease window stays open until the result applies
-                    # or the TTL expires; agent-side spans parent to it.
-                    job.lease_span_id = self.traces.open(
-                        job.job_id, "lease",
-                        parent_span_id=job.root_span_id, start_clock=now,
-                        attributes={
-                            "lease_id": lease_id, "agent": agent,
-                            "epoch": job.epoch, "attempt": job.attempts,
-                        },
-                    )
-                self.recorder.record(
-                    "lease", job_id=job.job_id, op=job.op,
-                    lease_id=lease_id, agent=agent, epoch=job.epoch,
-                    attempt=job.attempts,
-                )
-                if job.payload.pop("__collect_partials__", None):
-                    # Reduce-time materialization: dependency results
-                    # become the op's partials (kept out of the payload
-                    # until every shard result actually exists), in
-                    # submission order — shard order, for reduce ops
-                    # that are order-sensitive.
-                    job.payload["partials"] = [
-                        self._jobs[d].result
-                        for d in job.after_order
-                        if d in self._jobs
-                    ]
-                def out_task(j: Job = job) -> Dict[str, Any]:
-                    task = j.to_task()
-                    if wire_fmt and wire.encodable_task(j.op, j.payload):
-                        # Bulk ``texts`` columns ship binary to a
-                        # negotiated agent; the job's own payload (journal,
-                        # replay, /v1/jobs) stays plain JSON.
-                        task["payload"] = wire.encode_task_payload(j.payload)
-                        self._m_wire.inc(direction="task", format=wire_fmt)
-                    return task
+                    if job.payload.pop("__collect_partials__", None):
+                        # Reduce-time materialization: dependency results
+                        # become the op's partials (kept out of the payload
+                        # until every shard result actually exists), in
+                        # submission order — shard order, for reduce ops
+                        # that are order-sensitive.
+                        job.payload["partials"] = [
+                            self._jobs[d].result
+                            for d in job.after_order
+                            if d in self._jobs
+                        ]
+                    def out_task(j: Job = job) -> Dict[str, Any]:
+                        task = j.to_task()
+                        if wire_fmt and wire.encodable_task(j.op, j.payload):
+                            # Bulk ``texts`` columns ship binary to a
+                            # negotiated agent; the job's own payload (journal,
+                            # replay, /v1/jobs) stays plain JSON.
+                            task["payload"] = wire.encode_task_payload(j.payload)
+                            self._m_wire.inc(direction="task", format=wire_fmt)
+                        return task
 
-                tasks.append(out_task())
-                if duplicate:
-                    # Same task handed out twice under one lease: the
-                    # second completion must be idempotent/fenced.
                     tasks.append(out_task())
-                    duplicate = False
-                    self._m_faults.inc(fault="duplicate_task")
-                    self.recorder.record(
-                        "fault", fault="duplicate_task", job_id=job.job_id
-                    )
-                if stale:
-                    # Epoch bumps right after leasing → the agent's result
-                    # arrives carrying the old epoch and is discarded.
-                    job.epoch += 1
-                    stale = False
-                    self._m_faults.inc(fault="stale_epoch")
-                    self.recorder.record(
-                        "fault", fault="stale_epoch", job_id=job.job_id
-                    )
+                    if duplicate:
+                        # Same task handed out twice under one lease: the
+                        # second completion must be idempotent/fenced.
+                        tasks.append(out_task())
+                        duplicate = False
+                        self._m_faults.inc(fault="duplicate_task")
+                        self.recorder.record(
+                            "fault", fault="duplicate_task", job_id=job.job_id
+                        )
+                    if stale:
+                        # Epoch bumps right after leasing → the agent's result
+                        # arrives carrying the old epoch and is discarded.
+                        job.epoch += 1
+                        stale = False
+                        self._m_faults.inc(fault="stale_epoch")
+                        self.recorder.record(
+                            "fault", fault="stale_epoch", job_id=job.job_id
+                        )
+                if tasks or not cache_hits_round:
+                    break
+                # Every job this scan took landed straight from the
+                # result cache; their dependents may have just become
+                # serviceable. Rescan instead of granting an idle
+                # lease — bounded: each rescan only repeats if it
+                # finalized at least one more job.
             self._update_queue_stats_locked(now)
             if not tasks:
                 self._m_lease.inc(outcome="idle")
@@ -1997,7 +2712,7 @@ class Controller:
                 job.not_before = now
                 job.enqueued_clock = now
                 self.traces.finish(
-                    job.job_id, job.lease_span_id, now,
+                    job.trace_root, job.lease_span_id, now,
                     attributes={"outcome": "released"},
                 )
                 job.lease_span_id = None
@@ -2068,7 +2783,7 @@ class Controller:
                     )
             now = self._clock()
             self.traces.finish(
-                job.job_id, job.lease_span_id, now,
+                job.trace_root, job.lease_span_id, now,
                 attributes={"outcome": job.state},
             )
             job.lease_span_id = None
@@ -2077,7 +2792,7 @@ class Controller:
                 # retry classification + journal ordering), closing the
                 # submit→…→apply chain.
                 self.traces.add({
-                    "trace_id": job.job_id,
+                    "trace_id": job.trace_root,
                     "span_id": obs_trace.new_span_id(),
                     "parent_span_id": job.root_span_id,
                     "name": "apply",
@@ -2091,7 +2806,7 @@ class Controller:
                 })
             if job.state in TERMINAL_STATES:
                 self.traces.finish(
-                    job.job_id, job.root_span_id, now,
+                    job.trace_root, job.root_span_id, now,
                     attributes={"outcome": job.state},
                 )
                 # SLO feed (ISSUE 8): one observation per job, at terminal
@@ -2121,7 +2836,10 @@ class Controller:
             # Result bodies are journaled only for depended-on jobs (a reduce
             # will need them as partials after a restart) — journaling every
             # drain shard's output would make the journal an unbounded second
-            # copy of the dataset.
+            # copy of the dataset. Workflow members (ISSUE 19) keep theirs
+            # too: a DAG's sink result is the workflow's deliverable and must
+            # replay bit-identically; stage width is bounded by
+            # FLOW_MAX_WIDTH, so the journal stays bounded.
             record = {
                 "ev": "result",
                 "job_id": job.job_id,
@@ -2129,7 +2847,12 @@ class Controller:
                 "epoch": job.epoch,
                 "attempts": job.attempts,
                 "result": (
-                    job.result if job.job_id in self._depended_on else None
+                    job.result
+                    if (
+                        job.job_id in self._depended_on
+                        or job.workflow_id is not None
+                    )
+                    else None
                 ),
                 "error": job.error,
             }
@@ -2138,6 +2861,25 @@ class Controller:
                 # usage-less drains keep writing the exact legacy bytes.
                 record["usage"] = billed_usage
             self._journal(record)
+            if (
+                job.state == SUCCEEDED
+                and self.result_cache is not None
+                and is_cacheable(job.op)
+                and isinstance(job.result, dict)
+            ):
+                # Content-addressed memoization (ISSUE 19): the key covers
+                # the payload AS EXECUTED — for a reduce that includes the
+                # materialized partials, so an identical fan-in replays from
+                # cache only when every upstream byte matched too.
+                self.result_cache.put(job.op, job.payload, job.result)
+                self._m_result_cache.inc(event="put")
+            if job.state in TERMINAL_STATES:
+                # Workflow bookkeeping + downstream cascade AFTER this job's
+                # own journal record: replay must see the upstream terminal
+                # before any DependencyFailed deaths it caused.
+                self._workflow_note_terminal_locked(job, now)
+                if job.state in (FAILED, DEAD):
+                    self._cascade_dep_failure_locked(job, now)
             return {"accepted": True}
 
     # ---- online serving front door (ISSUE 15) ----
@@ -2161,6 +2903,37 @@ class Controller:
         cadence once its oldest rider waited ``SERVE_MAX_WAIT_MS``. Raises
         ``ValueError`` (HTTP 400) / ``AdmissionError`` (HTTP 429)."""
         door = self._require_serve()
+        if self.result_cache is not None and isinstance(text, str) and text:
+            # Front-door memoization (ISSUE 19): consulted BEFORE bucketing
+            # (and before admission — a hit costs no pending-budget slot).
+            # Keys cover op+text+params, not tenant: dedupe is global, the
+            # usage ledger attributes the hit to the asking tenant.
+            cached = self.result_cache.get(
+                f"infer:{op}", {"text": text, "params": dict(params or {})}
+            )
+            if cached is not None:
+                req = door.complete_cached(
+                    op, text, cached, params=params, tenant=tenant,
+                    priority=priority,
+                )
+                self._m_result_cache.inc(event="hit_infer")
+                self._m_serve_requests.inc(op=req.op, outcome="accepted")
+                self.recorder.record(
+                    "serve_request", req_id=req.req_id, op=req.op,
+                    tenant=req.tenant, cache_hit=True,
+                )
+                self.recorder.record(
+                    "cache_hit", req_id=req.req_id, op=req.op, plane="infer",
+                )
+                if self.usage is not None:
+                    self.usage.bill(
+                        req.req_id, tenant=req.tenant, tier=req.priority,
+                        op=SERVE_OPS[req.op], attempt=1,
+                        usage={"result_cache_hits": 1},
+                    )
+                self._note_serve_completions([req])
+                return req.req_id
+            self._m_result_cache.inc(event="miss")
         try:
             req, full = door.submit(
                 op, text, params=params, tenant=tenant, priority=priority,
@@ -2323,40 +3096,20 @@ class Controller:
                     ) if job.state == PENDING and job.after else None
                     if dead_dep is None:
                         continue
+                    # Catch-all: the generalized cascade (ISSUE 19) fires at
+                    # the upstream's terminal apply, but a reap can still race
+                    # ahead of it (replayed journals from before the cascade
+                    # existed, or a dep that died under a code path without
+                    # the hook). Drive the same cascade from the dead
+                    # upstream so the kill is identical either way.
                     now = self._clock()
-                    self._sched.discard(job_id)
-                    self._delayed.discard(job_id)
-                    job.error = {
-                        "type": "DependencyFailed",
-                        "message": (
-                            f"serve prefill dependency {dead_dep} failed"
-                        ),
-                        "trace": "",
-                    }
-                    job.state = DEAD
-                    self.traces.finish(
-                        job.job_id, job.root_span_id, now,
-                        attributes={
-                            "outcome": DEAD, "reason": "DependencyFailed",
-                        },
+                    self._cascade_dep_failure_locked(
+                        self._jobs[dead_dep], now
                     )
-                    self._slo_observe_locked(job, now)
-                    self._m_dead.inc(op=job.op)
-                    self.recorder.record(
-                        "dead", job_id=job_id, op=job.op,
-                        reason="dependency", attempts=job.attempts,
-                    )
-                    # Journaled as a result record so replay keeps it dead.
-                    self._journal({
-                        "ev": "result",
-                        "job_id": job_id,
-                        "state": DEAD,
-                        "epoch": job.epoch,
-                        "attempts": job.attempts,
-                        "result": None,
-                        "error": job.error,
-                    })
-                    ok, result, error = False, None, job.error
+                    if job.state not in TERMINAL_STATES:
+                        continue
+                    ok = job.state == SUCCEEDED
+                    result, error = job.result, job.error
                 else:
                     ok = job.state == SUCCEEDED
                     result, error = job.result, job.error
@@ -2506,6 +3259,21 @@ class Controller:
                 self._m_serve_ttft.observe(req.ttft_ms / 1e3, op=req.op)
             if req.tokens:
                 self._m_serve_tokens.inc(req.tokens, op=req.op)
+            if ok and req.job_id is not None \
+                    and self.result_cache is not None \
+                    and isinstance(req.result, dict):
+                # Populate the front-door cache from computed riders only
+                # (job_id None = this completion WAS a cache hit). The key
+                # re-includes max_length: it shaped the answer.
+                req_params = dict(req.params)
+                if req.max_length is not None:
+                    req_params["max_length"] = req.max_length
+                self.result_cache.put(
+                    f"infer:{req.op}",
+                    {"text": req.text, "params": req_params},
+                    req.result,
+                )
+                self._m_result_cache.inc(event="put")
             tel: Dict[str, Any] = (
                 req.telemetry if isinstance(req.telemetry, dict) else {}
             )

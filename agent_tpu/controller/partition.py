@@ -340,6 +340,28 @@ class RouterCore:
             parsed.setdefault("partition", name)
         return status, parsed
 
+    def route_workflow(self, body: Dict[str, Any]) -> Tuple[int, Any]:
+        """POST /v1/workflows. A DAG places as ONE unit by
+        ``{tenant, workflow_id}`` — the CSV ``source_uri`` rule generalized:
+        every stage job of a graph must share a partition or cross-partition
+        dep edges would never release. The router mints the workflow id when
+        the client didn't so placement stays a pure function and a client
+        retry with the same id lands on the same partition."""
+        tenant = body.get("tenant") or DEFAULT_TENANT
+        workflow_id = body.get("workflow_id") or f"wf-{uuid.uuid4().hex[:12]}"
+        body = dict(body, workflow_id=workflow_id)
+        name = self.pmap.ring.place(
+            placement_key(tenant, f"wf\x1f{workflow_id}")
+        )
+        status, parsed = self.post_partition(name, "/v1/workflows", body)
+        with self._lock:
+            self.counters["submits_total"] += 1
+            if status == 429:
+                self.counters["rejects_429_total"] += 1
+        if isinstance(parsed, dict):
+            parsed.setdefault("partition", name)
+        return status, parsed
+
     def route_infer(self, body: Dict[str, Any]) -> Tuple[int, Any]:
         tenant = body.get("tenant") or (
             (body.get("params") or {}).get("tenant")
@@ -589,6 +611,8 @@ class PartitionSession:
             status, parsed = self.core.route_result(body)
         elif path.endswith("/v1/jobs"):
             status, parsed = self.core.route_submit(body)
+        elif path.endswith("/v1/workflows"):
+            status, parsed = self.core.route_workflow(body)
         elif path.endswith("/v1/infer"):
             status, parsed = self.core.route_infer(body)
         else:

@@ -534,6 +534,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
         try:
             if self.path == "/v1/jobs":
                 status, parsed = core.route_submit(body)
+            elif self.path == "/v1/workflows":
+                status, parsed = core.route_workflow(body)
             elif self.path == "/v1/leases":
                 status, parsed = core.route_lease(body)
             elif self.path == "/v1/results":
@@ -641,7 +643,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._send(200, {"enabled": enabled, "requests": merged_reqs})
         elif path.startswith((
             "/v1/jobs/", "/v1/infer/", "/v1/trace/", "/v1/traces",
-            "/v1/debug/events", "/v1/profile/",
+            "/v1/debug/events", "/v1/profile/", "/v1/workflows/",
         )):
             self._first_found(path)
         else:

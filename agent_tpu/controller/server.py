@@ -283,6 +283,34 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_admission(exc)
             except (KeyError, ValueError, TypeError) as exc:
                 self._send(400, {"error": str(exc)})
+        elif self.path == "/v1/workflows":
+            # Workflow DAG engine (ISSUE 19): a fan-out/fan-in graph
+            # submitted as ONE unit; stages become ordinary dep-gated jobs.
+            try:
+                out = self.controller.submit_workflow(
+                    workflow=body,
+                    tenant=(
+                        str(body["tenant"])
+                        if body.get("tenant") is not None else None
+                    ),
+                    priority=body.get("priority"),
+                    deadline_sec=(
+                        float(body["deadline_sec"])
+                        if body.get("deadline_sec") is not None else None
+                    ),
+                    workflow_id=(
+                        str(body["workflow_id"])
+                        if body.get("workflow_id") is not None else None
+                    ),
+                )
+                self._send(200, out)
+            except AdmissionError as exc:
+                self._send_admission(exc)
+            except (KeyError, ValueError, TypeError) as exc:
+                self._send(400, {"error": str(exc)})
+            except RuntimeError as exc:
+                # FLOW_ENABLED=0: the subsystem is configured off.
+                self._send(501, {"error": str(exc)})
         elif self.path == "/v1/infer":
             # Online serving front door (ISSUE 15): one classify/summarize
             # request; blocks to the result by default, ?wait:false returns
@@ -394,6 +422,19 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             else:
                 self._send(200, body)
+            return
+        if path == "/v1/workflows":
+            # Workflow DAG summary list + result-cache stats (ISSUE 19) —
+            # swarmtop's Workflows panel reads this.
+            self._send(200, self.controller.workflows_json())
+            return
+        if path.startswith("/v1/workflows/"):
+            wf_id = path[len("/v1/workflows/"):]
+            out = self.controller.workflow_json(wf_id)
+            if out is None:
+                self._send(404, {"error": f"unknown workflow {wf_id!r}"})
+            else:
+                self._send(200, out)
             return
         if path == "/v1/usage":
             # Showback report (ISSUE 9): billed device/host seconds, FLOPs,
@@ -603,6 +644,7 @@ def main() -> int:
     import signal
 
     from agent_tpu.config import (
+        FlowConfig,
         JournalConfig,
         ObsConfig,
         SchedConfig,
@@ -648,6 +690,9 @@ def main() -> int:
         # SERVE_* knobs (ISSUE 15): the POST /v1/infer front door —
         # coalescing deadline/batch caps, length buckets, admission budget.
         serve=ServeConfig.from_env(),
+        # FLOW_* / CACHE_* knobs (ISSUE 19): workflow DAG limits + the
+        # content-addressed result cache (capacity, model version, price).
+        flow=FlowConfig.from_env(),
     )
     server = ControllerServer(controller, host=host, port=port)
     stop = threading.Event()

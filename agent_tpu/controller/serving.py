@@ -449,6 +449,79 @@ class ServeFrontDoor:
             self._cond.notify_all()
         return completed
 
+    def complete_cached(
+        self,
+        op: str,
+        text: Any,
+        result: Dict[str, Any],
+        params: Optional[Dict[str, Any]] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+    ) -> InferRequest:
+        """Mint an already-DONE request for a front-door result-cache hit
+        (ISSUE 19): the request never joins a bucket and never becomes a
+        job — the cached batch entry IS the answer, delivered at submit
+        time with TTFT ≈ 0. Only the fields the cache key does NOT cover
+        (tenant/priority) need validating here: a hit implies the keyed
+        fields (op/text/params) already passed ``submit`` validation once,
+        byte-for-byte."""
+        if op not in SERVE_OPS:
+            raise ValueError(
+                f"op must be one of {sorted(SERVE_OPS)}, got {op!r}"
+            )
+        if not isinstance(text, str) or not text:
+            raise ValueError("text must be a non-empty string")
+        if tenant is not None and (
+            not isinstance(tenant, str) or not tenant
+        ):
+            raise ValueError("tenant must be a non-empty string")
+        if priority is not None and (
+            isinstance(priority, bool) or not isinstance(priority, int)
+            or not 0 <= priority <= 9
+        ):
+            raise ValueError("priority must be an int in [0, 9]")
+        now = self._clock()
+        req = InferRequest(
+            req_id=(
+                f"req-{self.partition + '-' if self.partition else ''}"
+                f"{uuid.uuid4().hex[:12]}"
+            ),
+            op=op,
+            text=text,
+            params=dict(params or {}),
+            max_length=None,
+            tenant=tenant if tenant is not None else "default",
+            priority=(
+                priority if priority is not None else self.config.priority
+            ),
+            arrived_wall=time.time(),
+            arrived_clock=now,
+        )
+        req.bucket = self._bucket_len(text)
+        req.state = DONE
+        req.result = result
+        toks = result.get("tokens") if isinstance(result, dict) else None
+        req.tokens = int(toks) if isinstance(toks, (int, float)) else 0
+        req.latency_ms = 0.0
+        req.ttft_ms = 0.0
+        with self._cond:
+            self._requests[req.req_id] = req
+            self._retire_locked(req)
+            self._cond.notify_all()
+        if self._traces is not None:
+            req.root_span_id = self._traces.open(
+                req.req_id, "infer", start_clock=now,
+                attributes={
+                    "op": op, "tenant": req.tenant,
+                    "priority": req.priority, "bucket": req.bucket,
+                },
+            )
+            self._traces.finish(
+                req.req_id, req.root_span_id, now,
+                attributes={"outcome": "completed", "cache_hit": True},
+            )
+        return req
+
     def _retire_locked(self, req: InferRequest) -> None:
         self._done_ring.append(req.req_id)
         while len(self._done_ring) > DONE_RETENTION:

@@ -30,6 +30,8 @@ herd does not politely hold its requests either.
 
 from __future__ import annotations
 
+import bisect
+import functools
 import math
 import random
 import time
@@ -37,6 +39,30 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from agent_tpu.config import LoadgenConfig
+
+
+@functools.lru_cache(maxsize=64)
+def _zipf_cdf(n: int, s: float) -> Tuple[float, ...]:
+    weights = [1.0 / (i + 1) ** s for i in range(n)]
+    total = sum(weights)
+    acc = 0.0
+    cdf = []
+    for w in weights:
+        acc += w
+        cdf.append(acc / total)
+    return tuple(cdf)
+
+
+def zipf_rank(rng: random.Random, n: int, s: float) -> int:
+    """Draw a 0-based rank from a truncated zipfian over ``n`` items:
+    P(rank=k) ∝ 1/(k+1)^s. ``s=0`` is uniform; larger ``s`` concentrates
+    mass on low ranks — the head-heavy repeat distribution real request
+    streams show, and exactly what makes a content-addressed result cache
+    earn its keep (ISSUE 19). Deterministic given the caller's seeded rng."""
+    if n <= 1:
+        return 0
+    cdf = _zipf_cdf(int(n), float(s))
+    return min(n - 1, bisect.bisect_left(cdf, rng.random()))
 
 
 class Rejected(Exception):
@@ -63,7 +89,16 @@ class TrafficClass:
     (the serving front door, ``POST /v1/infer``). An infer class's ``op``
     is the REQUEST op (``classify``/``summarize``) and its payload carries
     ``{"text": ..., "params": {...}}`` — one traffic driver for
-    elastic_soak's job churn and the serving bench's interactive load."""
+    elastic_soak's job churn and the serving bench's interactive load.
+
+    ``payload_zipf_s`` (ISSUE 19) switches the class to a zipfian payload
+    MIX: each arrival draws a variant rank from ``zipf_rank(rng,
+    payload_pool, payload_zipf_s)`` and the payload is a deterministic
+    function of that rank alone — so popular variants recur byte-identical
+    (the repeats a result cache dedupes) while the tail stays cold. With a
+    ``payload_fn`` the rank replaces ``seq`` and the rng is freshly seeded
+    from the rank, making the built payload a pure function of the rank;
+    without one the template gains a ``"variant": rank`` field."""
 
     name: str
     op: str
@@ -74,14 +109,29 @@ class TrafficClass:
     payload: Dict[str, Any] = field(default_factory=dict)
     payload_fn: Optional[Callable[[random.Random, int], Dict[str, Any]]] = None
     route: str = "jobs"   # "jobs" | "infer"
+    payload_zipf_s: Optional[float] = None  # zipf exponent; None = off
+    payload_pool: int = 64                  # distinct variants when zipfian
 
     def __post_init__(self) -> None:
         if self.route not in ("jobs", "infer"):
             raise ValueError(
                 f"route must be 'jobs' or 'infer', got {self.route!r}"
             )
+        if self.payload_zipf_s is not None and self.payload_zipf_s < 0:
+            raise ValueError("payload_zipf_s must be >= 0")
+        if self.payload_pool < 1:
+            raise ValueError("payload_pool must be >= 1")
 
     def build_payload(self, rng: random.Random, seq: int) -> Dict[str, Any]:
+        if self.payload_zipf_s is not None:
+            rank = zipf_rank(rng, self.payload_pool, self.payload_zipf_s)
+            if self.payload_fn is not None:
+                # Fresh rank-seeded rng: the variant's payload is identical
+                # every time the rank recurs, whatever the arrival history.
+                return self.payload_fn(random.Random(rank), rank)
+            out = dict(self.payload)
+            out["variant"] = rank
+            return out
         if self.payload_fn is not None:
             return self.payload_fn(rng, seq)
         return dict(self.payload)

@@ -40,7 +40,7 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 # the wire is agent-controlled input).
 USAGE_FIELDS = (
     "device_s", "host_s", "flops", "rows", "chips", "wire_bytes",
-    "cache_hit_rows",
+    "cache_hit_rows", "result_cache_hits",
 )
 
 _ZERO = {
@@ -55,6 +55,10 @@ _ZERO = {
     # showback line that says how much compute a tenant's repeated prefixes
     # DIDN'T cost the fleet.
     "cache_hit_rows": 0,
+    # Whole results served from the content-addressed result cache
+    # (ISSUE 19): billed at cache price instead of chip-seconds; the
+    # per-tenant result_dedupe_ratio derives from this.
+    "result_cache_hits": 0,
 }
 
 
@@ -87,6 +91,7 @@ def _accumulate(bucket: Dict[str, Any], usage: Mapping[str, float],
     bucket["rows"] += int(usage.get("rows", 0))
     bucket["wire_bytes"] += int(wire_bytes) + int(usage.get("wire_bytes", 0))
     bucket["cache_hit_rows"] += int(usage.get("cache_hit_rows", 0))
+    bucket["result_cache_hits"] += int(usage.get("result_cache_hits", 0))
 
 
 def _rounded(bucket: Mapping[str, Any]) -> Dict[str, Any]:
@@ -99,6 +104,7 @@ def _rounded(bucket: Mapping[str, Any]) -> Dict[str, Any]:
         "rows": int(bucket["rows"]),
         "wire_bytes": int(bucket["wire_bytes"]),
         "cache_hit_rows": int(bucket["cache_hit_rows"]),
+        "result_cache_hits": int(bucket["result_cache_hits"]),
     }
 
 
@@ -111,10 +117,14 @@ class UsageLedger:
         top_k: int = 10,
         max_jobs: int = 4096,
         cost_per_chip_hour: float = 0.0,
+        cache_price_per_hit: float = 0.0,
     ) -> None:
         self.top_k = max(1, int(top_k))
         self.max_jobs = max(16, int(max_jobs))
         self.cost_per_chip_hour = max(0.0, float(cost_per_chip_hour))
+        # The "cache price": est-cost charged per result served from the
+        # content-addressed result cache (ISSUE 19) instead of chip-seconds.
+        self.cache_price_per_hit = max(0.0, float(cache_price_per_hit))
         self.started_wall = time.time()
         self._lock = threading.Lock()
         # {(tenant, tier, op): bucket} — the showback aggregate.
@@ -299,6 +309,20 @@ class UsageLedger:
             return None
         return round(chip_seconds / 3600.0 * self.cost_per_chip_hour, 6)
 
+    def _est_cost(self, bucket: Mapping[str, Any]) -> Optional[float]:
+        """Chip-second cost plus the cache price for deduped results —
+        None when neither price is configured (showback without rates)."""
+        chip = self._cost(float(bucket.get("chip_seconds", 0.0)))
+        cache = None
+        if self.cache_price_per_hit > 0:
+            cache = round(
+                float(bucket.get("result_cache_hits", 0) or 0)
+                * self.cache_price_per_hit, 6
+            )
+        if chip is None and cache is None:
+            return None
+        return round((chip or 0.0) + (cache or 0.0), 6)
+
     def report(
         self,
         top_k: Optional[int] = None,
@@ -340,19 +364,24 @@ class UsageLedger:
             "billed_tasks": billed,
             "evicted_jobs": evicted,
             "cost_per_chip_hour": self.cost_per_chip_hour,
+            "cache_price_per_hit": self.cache_price_per_hit,
             "totals": {
                 **_rounded(totals),
-                "est_cost": self._cost(totals["chip_seconds"]),
+                "est_cost": self._est_cost(totals),
                 "prefix_dedupe_ratio": _dedupe_ratio(totals),
+                "result_dedupe_ratio": _result_dedupe_ratio(totals),
             },
             "by_tenant": {
                 tenant: {
                     **_rounded(t),
-                    "est_cost": self._cost(t["chip_seconds"]),
+                    "est_cost": self._est_cost(t),
                     # What fraction of this tenant's prefill rows the prefix
                     # cache absorbed (ISSUE 17 satellite): cache_hit_rows
                     # was billed all along but never surfaced as a rate.
                     "prefix_dedupe_ratio": _dedupe_ratio(t),
+                    # What fraction of this tenant's billed results the
+                    # content-addressed result cache served (ISSUE 19).
+                    "result_dedupe_ratio": _result_dedupe_ratio(t),
                     "by_op": {
                         op: _rounded(b) for op, b in sorted(t["by_op"].items())
                     },
@@ -392,6 +421,17 @@ def _dedupe_ratio(bucket: Mapping[str, Any]) -> Optional[float]:
     if denom <= 0:
         return None
     return round(hits / denom, 4)
+
+
+def _result_dedupe_ratio(bucket: Mapping[str, Any]) -> Optional[float]:
+    """result_cache_hits / tasks — the share of billed result applications
+    the content-addressed result cache served instead of the fleet
+    computing them. None before anything billed."""
+    tasks = float(bucket.get("tasks", 0) or 0)
+    if tasks <= 0:
+        return None
+    hits = float(bucket.get("result_cache_hits", 0) or 0)
+    return round(hits / tasks, 4)
 
 
 def stamp_usage(tags: Optional[Dict[str, Any]], **fields: float) -> None:

@@ -72,6 +72,32 @@ OP_TO_MODULE: Dict[str, str] = {
     "train_classifier": "train_classifier",  # train → .npz artifact → serve
 }
 
+# Deterministic ops whose results may be served from the content-addressed
+# result cache (ISSUE 19): same payload + model version => bit-identical
+# result dict. Excluded on purpose: ``read_csv_shard`` (reads mutable files
+# behind a URI), the ERP triggers (external side effects), ``train_classifier``
+# (writes an artifact), and the decode-side serving ops (their payloads embed
+# per-request ids). The serving front door caches ``serve_classify`` /
+# ``serve_summarize`` at request granularity itself, keyed on
+# (op, text, params) before bucketing.
+CACHEABLE_OPS = frozenset(
+    {
+        "echo",
+        "map_tokenize",
+        "map_classify_tpu",
+        "map_summarize",
+        "summarize_encode",
+        "summarize_decode",
+        "risk_accumulate",
+    }
+)
+
+
+def is_cacheable(name: str) -> bool:
+    """True when ``name`` is registered as deterministic/cache-safe."""
+    return name in CACHEABLE_OPS
+
+
 _imported: Dict[str, bool] = {}
 _lock = threading.Lock()
 _plugins_loaded = False

@@ -286,7 +286,26 @@ class FairScheduler(Scheduler):
         """First job in FIFO order that is leasable *and* placeable on this
         agent; ineligible/deferred jobs keep their positions (no
         head-of-line blocking by a dep-gated reduce or a TPU-tagged job
-        waiting out its placement patience)."""
+        waiting out its placement patience).
+
+        Critical-path-first (ISSUE 19): when workflow stage jobs are queued
+        (``critical_path`` > 0 = longest remaining stage count), the
+        serviceable job with the most downstream work wins the pop; ties —
+        and the all-plain-jobs case — keep exact FIFO order, so non-DAG
+        drains are byte-identical to the pre-DAG scheduler."""
+        if any(getattr(j, "critical_path", 0) > 0 for j in q):
+            best = None
+            for job in q:
+                if (
+                    (best is None or getattr(job, "critical_path", 0)
+                     > getattr(best, "critical_path", 0))
+                    and eligible(job)
+                    and self._placement_ok(job, ctx)
+                ):
+                    best = job
+            if best is not None:
+                q.remove(best)
+            return best
         for job in q:
             if eligible(job) and self._placement_ok(job, ctx):
                 q.remove(job)

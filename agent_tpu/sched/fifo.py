@@ -45,17 +45,29 @@ class FifoScheduler(Scheduler):
     def take(
         self, ctx: LeaseContext, eligible: Callable[[Any], bool]
     ) -> List[Any]:
-        # The historical scan, verbatim: one pass, eligibility checked in
-        # queue order, ineligible and over-limit jobs keep their positions.
+        # The historical scan with one refinement (ISSUE 19): jobs on a
+        # workflow's critical path (``critical_path`` = longest remaining
+        # stage count, 0 for plain jobs) are scanned first. The sort is
+        # stable, so with no DAG jobs queued the scan order — and therefore
+        # the drain order and journal bytes — is bit-identical to the
+        # historical one-pass walk. Linear chains have strictly decreasing
+        # critical_path along arrival order, so they also degrade to plain
+        # FIFO (pinned by tests/test_flow.py's property test). Non-taken
+        # jobs keep their original arrival positions either way.
+        scan = sorted(
+            self._order, key=lambda j: -getattr(j, "critical_path", 0)
+        )
         taken: List[Any] = []
-        remaining: List[Any] = []
-        for job in self._order:
-            if len(taken) < ctx.limit and eligible(job):
+        taken_ids: set = set()
+        for job in scan:
+            if len(taken) >= ctx.limit:
+                break
+            if eligible(job):
                 taken.append(job)
+                taken_ids.add(id(job))
                 self._note_remove(job)
-            else:
-                remaining.append(job)
-        self._order = remaining
+        if taken:
+            self._order = [j for j in self._order if id(j) not in taken_ids]
         return taken
 
     def queued_ids(self) -> List[str]:

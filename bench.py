@@ -1936,6 +1936,129 @@ def _bench_serving(runtime):
     return leg
 
 
+DAG_WORKFLOWS = 40        # zipfian submissions per leg
+DAG_FAN = 4               # classify shards per workflow (7 jobs each)
+DAG_TEXTS = 256           # rows per classify shard (real forward pass)
+DAG_POOL = 8              # distinct payload variants
+DAG_ZIPF_S = 1.3          # head-heavy: most submissions repeat a variant
+
+
+def _bench_dag_cache() -> dict:
+    """Workflow DAG + result cache leg (ISSUE 19): a zipfian mix of
+    fan-out/fan-in workflows (echo → DAG_FAN classify shards → collect →
+    report) drained twice — cache OFF (every stage computes) and cache ON
+    (repeated variants land as content-addressed hits) — through the
+    in-process lease/report loop executing the REAL ops.
+
+    Asserts the acceptance bar: the warm leg's hit rate clears 0.6 and its
+    effective rows/sec is ≥2× the cold leg's. The hit count is
+    deterministic given the seed (a function of the zipf draw, not
+    timing); the classify forward pass supplies real per-shard compute, so
+    the speedup measures cache-skipped work, not bookkeeping noise.
+    """
+    import random as _random
+
+    from agent_tpu.config import FlowConfig
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.loadgen import zipf_rank
+    from agent_tpu.ops import load_ops
+    from agent_tpu.runtime.context import OpContext
+
+    tiny_cls = {
+        "d_model": 32, "n_heads": 4, "n_layers": 1, "d_ff": 64,
+        "max_len": 64, "dtype": "float32", "n_classes": 16,
+    }
+    handlers = load_ops(["echo", "map_classify_tpu"])
+    ctx = OpContext()
+
+    def cls_payload(variant: int) -> dict:
+        return {
+            "texts": [
+                f"classify row {i} variant {variant}"
+                for i in range(DAG_TEXTS)
+            ],
+            "model_config": tiny_cls, "topk": 2,
+            "result_format": "columnar",
+        }
+
+    def variant_doc(variant: int) -> dict:
+        return {"stages": [
+            {"name": "tok", "op": "echo", "payload": {"variant": variant}},
+            {"name": "cls", "op": "map_classify_tpu",
+             "payload": cls_payload(variant),
+             "after": ["tok"], "fan_out": DAG_FAN, "collect": False},
+            {"name": "acc", "op": "echo", "payload": {},
+             "after": ["cls"]},
+            {"name": "rep", "op": "echo", "payload": {"variant": variant},
+             "after": ["acc"]},
+        ]}
+
+    # Pay the classify compile before either timed leg (production pays it
+    # at boot; the cold leg must measure execution, not tracing).
+    handlers["map_classify_tpu"](cls_payload(0), ctx)
+
+    def run_leg(cache_enabled: bool):
+        controller = Controller(
+            flow=FlowConfig(cache_enabled=cache_enabled),
+        )
+        rng = _random.Random(19)
+        jobs = 0
+        t0 = time.perf_counter()
+        for _ in range(DAG_WORKFLOWS):
+            variant = zipf_rank(rng, DAG_POOL, DAG_ZIPF_S)
+            out = controller.submit_workflow(variant_doc(variant))
+            jobs += len(out["job_ids"])
+            deadline = time.monotonic() + 300
+            while True:
+                lease = controller.lease(
+                    "bench", {"ops": sorted(handlers)}, max_tasks=8,
+                )
+                if lease is None:
+                    wj = controller.workflow_json(out["workflow_id"])
+                    if wj["state"] != "running":
+                        break
+                    assert time.monotonic() < deadline, wj
+                    continue
+                for t in lease["tasks"]:
+                    result = handlers[t["op"]](t["payload"], ctx)
+                    controller.report(
+                        lease["lease_id"], t["id"], t["job_epoch"],
+                        "succeeded", result=result,
+                    )
+        wall = time.perf_counter() - t0
+        stats = (
+            controller.result_cache.stats()
+            if controller.result_cache is not None else None
+        )
+        return jobs, wall, stats
+
+    cold_jobs, cold_wall, _ = run_leg(cache_enabled=False)
+    warm_jobs, warm_wall, stats = run_leg(cache_enabled=True)
+    assert cold_jobs == warm_jobs, (cold_jobs, warm_jobs)
+    cold_rate = cold_jobs / cold_wall
+    warm_rate = warm_jobs / warm_wall
+    hit_rate = stats["hit_rate"]
+    speedup = warm_rate / cold_rate
+    assert hit_rate >= 0.6, (
+        f"zipfian mix hit rate {hit_rate:.2f} below 0.6 "
+        f"(hits {stats['hits']}, misses {stats['misses']})"
+    )
+    assert speedup >= 2.0, (
+        f"cache effective speedup {speedup:.2f}x below the 2x bar "
+        f"(cold {cold_rate:.0f} rows/s, warm {warm_rate:.0f} rows/s)"
+    )
+    return {
+        "workflows": DAG_WORKFLOWS,
+        "stage_jobs": cold_jobs,
+        "rows_per_sec": round(cold_rate, 1),
+        "effective_rows_per_sec": round(warm_rate, 1),
+        "effective_speedup": round(speedup, 3),
+        "hit_rate": round(hit_rate, 4),
+        "hits": stats["hits"],
+        "misses": stats["misses"],
+    }
+
+
 def main() -> int:
     from agent_tpu.runtime.runtime import get_runtime
 
@@ -2022,6 +2145,14 @@ def main() -> int:
         }
     except Exception as exc:  # noqa: BLE001
         legs["controller"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+
+    # Workflow DAG + result cache (ISSUE 19): zipfian fan-out/fan-in mix,
+    # cold vs cache-warm — asserts hit rate and the ≥2x effective-rate bar.
+    try:
+        legs["dag_cache"] = _bench_dag_cache()
+    except Exception as exc:  # noqa: BLE001 — an AssertionError here is
+        # the cache failing its own acceptance bar; it must surface.
+        legs["dag_cache"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
     try:
         classify_drain, mixed_drain = _bench_drain(runtime)
@@ -2252,6 +2383,13 @@ def main() -> int:
                 .get("agg_submits_per_sec"),
                 "controller_agg_speedup_vs_single": legs["controller"]
                 .get("agg_speedup_vs_single"),
+                # Workflow DAG + result cache flat fields (ISSUE 19): cold
+                # DAG drain throughput, the zipfian mix's dedupe hit rate,
+                # and the effective-rate multiple the cache buys.
+                "dag_rows_per_sec": legs["dag_cache"].get("rows_per_sec"),
+                "cache_hit_rate": legs["dag_cache"].get("hit_rate"),
+                "cache_effective_speedup": legs["dag_cache"]
+                .get("effective_speedup"),
             }
         ),
         flush=True,

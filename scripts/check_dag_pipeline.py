@@ -1,0 +1,332 @@
+#!/usr/bin/env python3
+"""CI smoke for the workflow DAG engine + result cache (ISSUE 19).
+
+Drives a 4-stage fan-out/fan-in workflow (tokenize → 3 accumulate shards →
+reduce → report) through real agents, twice over each transport, and asserts
+the DAG acceptance bar:
+
+1. LOOPBACK leg (``chaos.LoopbackSession``, real ``Agent`` loop, no
+   sockets): the DAG drains end-to-end with every stage SUCCEEDED and ONE
+   complete trace tree — a single root span, every other span's parent
+   resolving inside the tree;
+2. a second byte-identical submission is served ≥90% from the result cache
+   (here: fully — zero additional agent executions) with BIT-IDENTICAL
+   results, and the per-tenant dedupe ratio shows up in the usage report;
+3. a stage that permanently fails cascades ``DependencyFailed`` through
+   every downstream stage — nothing leases, nothing hangs;
+4. a controller crash mid-DAG (journal truncated at a torn tail, no
+   close) replays into a rebuilt in-flight workflow — terminal stages
+   stay terminal, the critical stage is re-armed — and the resumed run's
+   final output is bit-identical to an uncrashed reference run;
+5. HTTP leg (real ``ControllerServer`` + ``requests`` + a pipelined
+   agent): ``POST /v1/workflows`` → ``GET /v1/workflows/{id}`` to
+   terminal, cached rerun bit-identical, dedupe ratio in ``/v1/usage``.
+
+CPU-shape smoke (host-only ops, JAX_PLATFORMS=cpu). Exit 0 = all bars met.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DOC = {
+    "stages": [
+        {"name": "tok", "op": "map_tokenize",
+         "payload": {"text": "dag smoke corpus " * 16, "mode": "chars",
+                     "chunk_size": 32}},
+        {"name": "cls", "op": "risk_accumulate",
+         "payload": {"values": [1.0, 2.0, 3.0, 5.0]},
+         "after": ["tok"], "fan_out": 3, "collect": False},
+        {"name": "acc", "op": "risk_accumulate", "payload": {},
+         "after": ["cls"]},
+        {"name": "rep", "op": "echo", "payload": {"final": True},
+         "after": ["acc"]},
+    ]
+}
+
+# All-echo variant for the crash leg: echo results carry no timings, so a
+# resumed run's recomputed stages byte-match an uncrashed reference.
+ECHO_DOC = {
+    "stages": [
+        {"name": "tok", "op": "echo", "payload": {"v": 1}},
+        {"name": "cls", "op": "echo", "payload": {"v": 2},
+         "after": ["tok"], "fan_out": 3, "collect": False},
+        {"name": "acc", "op": "echo", "payload": {},
+         "after": ["cls"]},
+        {"name": "rep", "op": "echo", "payload": {"final": True},
+         "after": ["acc"]},
+    ]
+}
+
+OPS = ("echo", "map_tokenize", "risk_accumulate")
+
+
+def make_loopback_agent(controller, name="dag-smoke"):
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.chaos import LoopbackSession
+    from agent_tpu.config import AgentConfig, Config
+
+    cfg = Config(agent=AgentConfig(
+        controller_url="http://loopback", agent_name=name,
+        tasks=OPS, max_tasks=4,
+        idle_sleep_sec=0.0, error_backoff_sec=0.0,
+        retry_base_sec=0.001, retry_max_sec=0.01,
+    ))
+    agent = Agent(config=cfg, session=LoopbackSession(controller))
+    # The pipelined poster thread builds its own requests.Session unless
+    # told otherwise — route it through the loopback too.
+    agent.post_session_factory = lambda: agent.session
+    agent._profile = {"tier": "smoke"}
+    return agent
+
+
+def wait_workflow(controller, wid, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        wj = controller.workflow_json(wid)
+        if wj is not None and wj["state"] in ("succeeded", "dead"):
+            return wj
+        assert time.monotonic() < deadline, (
+            f"workflow {wid} stuck: {wj and wj['state']}"
+        )
+        time.sleep(0.02)
+
+
+def run_agent_while(controller, fn):
+    agent = make_loopback_agent(controller)
+    t = threading.Thread(target=agent.run, daemon=True)
+    t.start()
+    try:
+        return fn()
+    finally:
+        agent.running = False
+        t.join(timeout=60)
+
+
+def results_bytes(wj):
+    return json.dumps(wj["results"], sort_keys=True).encode()
+
+
+def assert_one_trace_tree(controller, wid, n_jobs):
+    spans = controller.traces.spans(wid)
+    roots = [s for s in spans if not s.get("parent_span_id")]
+    assert len(roots) == 1, f"{len(roots)} roots in trace {wid}"
+    assert roots[0]["name"] == "workflow", roots[0]
+    ids = {s["span_id"] for s in spans}
+    dangling = [
+        s["name"] for s in spans
+        if s.get("parent_span_id") and s["parent_span_id"] not in ids
+    ]
+    assert not dangling, f"spans with unresolved parents: {dangling}"
+    assert len(spans) > n_jobs, f"only {len(spans)} spans for {n_jobs} jobs"
+
+
+def loopback_leg():
+    """Bars 1-3 over LoopbackSession."""
+    from agent_tpu.controller.core import Controller
+
+    controller = Controller(lease_ttl_sec=600.0)
+
+    # Bar 1: drain + single trace tree.
+    out = controller.submit_workflow(DOC, tenant="acme")
+    wid = out["workflow_id"]
+    assert out["stages"] == ["tok", "cls", "acc", "rep"]
+    wj1 = run_agent_while(controller, lambda: wait_workflow(controller, wid))
+    assert wj1["state"] == "succeeded", wj1
+    assert wj1["terminal_jobs"] == wj1["total_jobs"] == 6
+    (rep,) = wj1["results"].values()
+    assert rep["echo"]["partials"][0]["count"] == 12  # 3 shards x 4 values
+    assert_one_trace_tree(controller, wid, 6)
+
+    # Bar 2: byte-identical resubmission, served from cache. The agent
+    # keeps polling, but every stage lands as a lease-path cache hit —
+    # cache_hits == total_jobs proves zero re-executions.
+    out2 = controller.submit_workflow(DOC, tenant="acme")
+    wj2 = run_agent_while(
+        controller,
+        lambda: wait_workflow(controller, out2["workflow_id"], timeout=30.0),
+    )
+    assert wj2["state"] == "succeeded", wj2
+    assert wj2["cache_hits"] >= 0.9 * wj2["total_jobs"], wj2["cache_hits"]
+    assert wj2["cache_hits"] == wj2["total_jobs"] == 6, wj2
+    assert json.dumps(list(wj1["results"].values()), sort_keys=True) \
+        == json.dumps(list(wj2["results"].values()), sort_keys=True)
+    usage = controller.usage_json()
+    assert usage["totals"]["result_cache_hits"] == wj2["cache_hits"]
+    assert usage["by_tenant"]["acme"]["result_dedupe_ratio"] is not None
+
+    # Bar 3: DependencyFailed cascade from a permanently failing stage.
+    out3 = controller.submit_workflow({
+        "stages": [
+            # A failed-shard partial makes risk_accumulate raise (hard
+            # failure, not an ok:False soft result) — with max_attempts=1
+            # the stage dies permanently and the cascade must fire.
+            {"name": "boom", "op": "risk_accumulate",
+             "payload": {"partials": [{"ok": False, "error": "poisoned"}]},
+             "max_attempts": 1, "collect": False},
+            {"name": "victim", "op": "echo", "payload": {},
+             "after": ["boom"]},
+        ]
+    })
+    wj3 = run_agent_while(
+        controller,
+        lambda: wait_workflow(controller, out3["workflow_id"]),
+    )
+    assert wj3["state"] == "dead", wj3
+    assert wj3["terminal_jobs"] == 2
+    victim = controller.job_snapshot(out3["job_ids"][-1])
+    assert victim["state"] == "dead"
+    assert victim["error"]["type"] == "DependencyFailed", victim["error"]
+    assert controller.lease("probe", {"ops": list(OPS)}) is None
+
+    return (
+        f"loopback: drained 6/6 with 1 trace tree, rerun "
+        f"{wj2['cache_hits']}/{wj2['total_jobs']} from cache bit-identical, "
+        f"cascade killed {wj3['terminal_jobs']} jobs"
+    )
+
+
+def deterministic_drain(controller, limit=None):
+    """Drain echo jobs through the public lease/report API with
+    deterministic result bodies (agent wrappers embed random lease ids,
+    which would defeat the byte-compare)."""
+    done = 0
+    while limit is None or done < limit:
+        lease = controller.lease("det", {"ops": ["echo"]}, max_tasks=1)
+        if lease is None:
+            break
+        for t in lease["tasks"]:
+            controller.report(
+                lease["lease_id"], t["id"], t["job_epoch"], "succeeded",
+                result={"ok": True, "echo": t["payload"]},
+            )
+            done += 1
+    return done
+
+
+def crash_replay_leg(tmpdir):
+    """Bar 4: kill the controller mid-DAG, replay, finish, byte-compare."""
+    from agent_tpu.config import FlowConfig
+    from agent_tpu.controller.core import Controller
+
+    # Reference: same DAG, no crash. Cache off so every stage really runs.
+    ref = Controller(flow=FlowConfig(cache_enabled=False))
+    rout = ref.submit_workflow(ECHO_DOC, workflow_id="wf-crash")
+    deterministic_drain(ref)
+    ref_wj = ref.workflow_json(rout["workflow_id"])
+    assert ref_wj["state"] == "succeeded"
+
+    # Crashing run: drain tok + the 3 cls shards, then die WITHOUT close().
+    jp = os.path.join(tmpdir, "journal.jsonl")
+    c1 = Controller(journal_path=jp, flow=FlowConfig(cache_enabled=False))
+    c1.submit_workflow(ECHO_DOC, workflow_id="wf-crash")
+    assert deterministic_drain(c1, limit=4) == 4
+    # Simulate the kill: a torn, unflushed final line on the journal tail.
+    with open(jp, "ab") as f:
+        f.write(b'{"ev": "result", "job_id": "wf-crash')
+
+    c2 = Controller(journal_path=jp, flow=FlowConfig(cache_enabled=False))
+    wj = c2.workflow_json("wf-crash")
+    assert wj is not None and wj["state"] == "running", wj
+    assert wj["terminal_jobs"] == 4, wj
+    assert wj["critical_stage"] == "acc", wj
+    deterministic_drain(c2)
+    got_wj = c2.workflow_json("wf-crash")
+    assert got_wj["state"] == "succeeded", got_wj
+    assert results_bytes(got_wj) == results_bytes(ref_wj), (
+        "resumed DAG output diverged from the uncrashed reference"
+    )
+    return "crash-replay: resumed 4/6 -> 6/6, output bit-identical"
+
+
+def http_leg():
+    """Bar 5: the same contract over real sockets."""
+    import requests
+
+    from agent_tpu.agent.app import Agent
+    from agent_tpu.agent.pipeline import PipelineRunner
+    from agent_tpu.config import AgentConfig, Config
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+
+    controller = Controller(lease_ttl_sec=600.0)
+    server = ControllerServer(controller).start()
+    try:
+        cfg = Config(agent=AgentConfig(
+            controller_url=server.url, agent_name="dag-http",
+            tasks=OPS, idle_sleep_sec=0.0,
+        ))
+        agent = Agent(config=cfg, session=requests.Session())
+        agent._profile = {"tier": "smoke"}
+        runner = PipelineRunner(agent, depth=2)
+        t = threading.Thread(target=runner.run, daemon=True)
+        t.start()
+        sess = requests.Session()
+
+        def submit():
+            r = sess.post(server.url + "/v1/workflows",
+                          json=dict(DOC, tenant="acme"), timeout=30)
+            assert r.status_code == 200, r.text
+            return r.json()["workflow_id"]
+
+        def wait_http(wid):
+            deadline = time.monotonic() + 120
+            while True:
+                r = sess.get(server.url + f"/v1/workflows/{wid}", timeout=30)
+                assert r.status_code == 200, r.text
+                wj = r.json()
+                if wj["state"] in ("succeeded", "dead"):
+                    return wj
+                assert time.monotonic() < deadline, wj
+                time.sleep(0.05)
+
+        wj1 = wait_http(submit())
+        assert wj1["state"] == "succeeded", wj1
+        wj2 = wait_http(submit())
+        assert wj2["state"] == "succeeded", wj2
+        assert wj2["cache_hits"] >= 0.9 * wj2["total_jobs"], wj2
+        assert json.dumps(list(wj1["results"].values()), sort_keys=True) \
+            == json.dumps(list(wj2["results"].values()), sort_keys=True)
+        r = sess.get(server.url + "/v1/usage", timeout=30)
+        assert r.status_code == 200, r.text
+        usage = r.json()
+        assert usage["totals"]["result_cache_hits"] >= wj2["cache_hits"]
+        assert usage["by_tenant"]["acme"]["result_dedupe_ratio"] is not None
+        agent.running = False
+        t.join(timeout=60)
+        return (
+            f"http: 2 submits, rerun {wj2['cache_hits']}/"
+            f"{wj2['total_jobs']} cached, dedupe ratio "
+            f"{usage['by_tenant']['acme']['result_dedupe_ratio']}"
+        )
+    finally:
+        server.stop()
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory() as td:
+        print("[dag-smoke] loopback leg ...", flush=True)
+        line1 = loopback_leg()
+        print("[dag-smoke] crash-replay leg ...", flush=True)
+        line2 = crash_replay_leg(td)
+        print("[dag-smoke] http leg ...", flush=True)
+        line3 = http_leg()
+    print(
+        f"[dag-smoke] OK: {line1}; {line2}; {line3}; "
+        f"wall {time.monotonic() - t0:.1f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -244,6 +244,26 @@ def partition_rows(status, health):
     return rows
 
 
+def workflow_rows(base: str):
+    """Workflows panel feed (ISSUE 19): the DAG list off ``GET
+    /v1/workflows`` (per-DAG stage progress, critical-path stage, cache
+    hits), the result-cache counters, and per-tenant dedupe ratios off
+    ``/v1/usage``. None against a controller predating workflows."""
+    body = fetch_json(base + "/v1/workflows")
+    if not isinstance(body, dict) or "workflows" not in body:
+        return None
+    dedupe = {}
+    usage = fetch_json(base + "/v1/usage")
+    for tenant, rec in ((usage or {}).get("by_tenant") or {}).items():
+        if isinstance(rec, dict) and rec.get("result_dedupe_ratio"):
+            dedupe[tenant] = rec["result_dedupe_ratio"]
+    return {
+        "workflows": body.get("workflows") or [],
+        "result_cache": body.get("result_cache"),
+        "dedupe_by_tenant": dedupe,
+    }
+
+
 def tasks_total(metrics_text) -> float:
     """Fleet-wide completed tasks off the exposition (unlabeled merge only —
     ``agent``-labeled duplicates would double-count). The scrape-delta
@@ -321,7 +341,8 @@ def last_value(points):
 
 
 def render(health, status, rate, colors: Colors, trends=None,
-           serving=None, req_tail=None, partitions=None) -> str:
+           serving=None, req_tail=None, partitions=None,
+           workflows=None) -> str:
     lines = []
     verdict = health.get("verdict", "?")
     now = time.strftime("%H:%M:%S")
@@ -446,6 +467,61 @@ def render(health, status, rate, colors: Colors, trends=None,
                     f"{dom_s:<22}"
                 )
                 if rec.get("outcome") != "completed":
+                    line = colors.paint(line, FG["warn"])
+                lines.append(line)
+        lines.append("")
+
+    if workflows is not None:
+        # Workflows panel (ISSUE 19): active DAGs with stage progress and
+        # the critical-path stage the scheduler is preferring, plus the
+        # content-addressed result cache's dedupe numbers.
+        wfs = workflows.get("workflows") or []
+        active = [w for w in wfs if w.get("state") == "running"]
+        cache = workflows.get("result_cache")
+        head = (
+            f"{colors.paint('Workflows', BOLD)}  active {len(active)}"
+            f"  total {len(wfs)}"
+        )
+        if cache:
+            head += (
+                f"  cache: {fmt_pct(cache.get('hit_rate'), 1)} hit"
+                f"  {fmt_num(cache.get('entries'), 0)}/"
+                f"{fmt_num(cache.get('capacity'), 0)} entries"
+                f"  model {cache.get('model_version')}"
+            )
+        lines.append(head)
+        dedupe = workflows.get("dedupe_by_tenant") or {}
+        if dedupe:
+            lines.append(colors.paint(
+                "  dedupe: " + " ".join(
+                    f"{t}={fmt_pct(r, 1)}"
+                    for t, r in sorted(dedupe.items())
+                ), DIM))
+        shown = active[:5] if active else wfs[-3:]
+        if shown:
+            lines.append(colors.paint(
+                f"  {'workflow':<22}{'tenant':<10}{'state':<11}"
+                f"{'progress':<16}{'jobs':>9}{'hits':>6}"
+                f"  {'critical stage':<14}", DIM))
+            for w in shown:
+                total = w.get("total_jobs") or 0
+                done = w.get("terminal_jobs") or 0
+                frac = done / total if total else 0.0
+                state = str(w.get("state", "?"))
+                state_cell = colors.paint(
+                    state.upper(),
+                    FG.get("page" if state == "dead" else "ok", ""),
+                ) + " " * max(0, 11 - len(state))
+                line = (
+                    f"  {str(w.get('workflow_id'))[:21]:<22}"
+                    f"{str(w.get('tenant'))[:9]:<10}"
+                    f"{state_cell}"
+                    f"{bar(frac, 10)} {fmt_pct(frac, 0):>4} "
+                    f"{done:>4}/{total:<4}"
+                    f"{w.get('cache_hits', 0):>6}"
+                    f"  {str(w.get('critical_stage') or '-')[:13]:<14}"
+                )
+                if w.get("failed_jobs"):
                     line = colors.paint(line, FG["warn"])
                 lines.append(line)
         lines.append("")
@@ -588,6 +664,7 @@ def main() -> int:
         serving = serving_summary(metrics_text, status)
         req_tail = request_tail(base) if serving is not None else None
         partitions = partition_rows(status, health)
+        workflows = workflow_rows(base)
         if args.json:
             # One-shot scripting mode (ISSUE 9 satellite): everything the
             # dashboard renders, as one JSON doc on stdout.
@@ -601,6 +678,7 @@ def main() -> int:
                 "serving": serving,
                 "request_tail": req_tail,
                 "partitions": partitions,
+                "workflows": workflows,
                 "rates": {
                     "tasks_per_sec": last_value(trends["tasks_per_sec"]),
                     "rows_per_sec": last_value(trends["rows_per_sec"]),
@@ -623,7 +701,7 @@ def main() -> int:
             prev_tasks, prev_t = total, now
         frame = render(health, status, rate, colors, trends=trends,
                        serving=serving, req_tail=req_tail,
-                       partitions=partitions)
+                       partitions=partitions, workflows=workflows)
         if args.once:
             sys.stdout.write(frame)
             return 0

@@ -1,0 +1,596 @@
+"""Workflow DAG engine + content-addressed result cache (ISSUE 19).
+
+Covers: DAG validation/expansion, critical-path-first scheduling (pinned
+bit-compatible with plain FIFO for linear graphs), the ResultCache unit
+surface, end-to-end fan-out/fan-in drains with a single-rooted trace tree,
+cache-hit replays (byte-identical, ≥90% second-submission hit rate, dedupe
+ratio in /v1/usage), journal replay mid-DAG, the replay-ordering
+DependencyFailed regression, the LoopbackSession /v1/workflows route, the
+/v1/infer front-door cache, and loadgen's zipfian payload mix.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+import pytest
+
+from agent_tpu.chaos import LoopbackSession
+from agent_tpu.config import FlowConfig, SchedConfig
+from agent_tpu.controller.core import Controller
+from agent_tpu.flow.dag import (
+    DagError,
+    critical_path_lengths,
+    expand_workflow,
+    parse_workflow,
+    toposort_stages,
+)
+from agent_tpu.flow.result_cache import ResultCache, result_key
+from agent_tpu.loadgen import TrafficClass, zipf_rank
+
+KNOWN = ["echo", "map_tokenize", "risk_accumulate", "map_summarize"]
+
+FANOUT_DOC = {
+    "stages": [
+        {"name": "tok", "op": "echo", "payload": {"v": 1}},
+        {"name": "cls", "op": "echo", "payload": {"v": 2},
+         "after": ["tok"], "fan_out": 3},
+        {"name": "acc", "op": "risk_accumulate", "payload": {},
+         "after": ["cls"]},
+        {"name": "rep", "op": "echo", "payload": {"final": True},
+         "after": ["acc"]},
+    ]
+}
+
+
+def drain(c, ops=("echo", "risk_accumulate"), rounds=50, status="succeeded"):
+    """Minimal inline agent: echo returns its payload (minus the collect
+    marker), risk_accumulate counts its partials."""
+    leases = 0
+    for _ in range(rounds):
+        lease = c.lease("a1", {"ops": list(ops)}, max_tasks=8)
+        if lease is None:
+            break
+        leases += 1
+        for t in lease["tasks"]:
+            if status != "succeeded":
+                c.report(lease["lease_id"], t["id"], t["job_epoch"], status,
+                         error={"type": "ValueError", "message": "boom",
+                                "trace": ""})
+                continue
+            if t["op"] == "risk_accumulate":
+                res = {"n": len(t["payload"].get("partials", []))}
+            else:
+                res = {k: v for k, v in t["payload"].items()
+                       if k != "__collect_partials__"}
+            c.report(lease["lease_id"], t["id"], t["job_epoch"],
+                     "succeeded", result=res)
+    return leases
+
+
+# ---------------------------------------------------------------------------
+# DAG validation + expansion (pure half)
+# ---------------------------------------------------------------------------
+
+
+class TestDagValidation:
+    def test_valid_fanout_fanin_parses(self):
+        spec = parse_workflow(FANOUT_DOC, KNOWN)
+        assert [s.name for s in spec.stages] == ["tok", "cls", "acc", "rep"]
+        assert toposort_stages(spec) == ["tok", "cls", "acc", "rep"]
+
+    def test_cycle_rejected(self):
+        doc = {"stages": [
+            {"name": "a", "op": "echo", "after": ["b"]},
+            {"name": "b", "op": "echo", "after": ["a"]},
+        ]}
+        with pytest.raises(DagError, match="cycle"):
+            parse_workflow(doc, KNOWN)
+
+    def test_unknown_op_rejected(self):
+        doc = {"stages": [{"name": "a", "op": "nope"}]}
+        with pytest.raises(DagError, match="unknown op"):
+            parse_workflow(doc, KNOWN)
+
+    def test_duplicate_stage_name_rejected(self):
+        doc = {"stages": [
+            {"name": "a", "op": "echo"}, {"name": "a", "op": "echo"},
+        ]}
+        with pytest.raises(DagError, match="duplicate"):
+            parse_workflow(doc, KNOWN)
+
+    def test_unknown_after_and_self_dep_rejected(self):
+        with pytest.raises(DagError, match="unknown"):
+            parse_workflow(
+                {"stages": [{"name": "a", "op": "echo", "after": ["z"]}]},
+                KNOWN,
+            )
+        with pytest.raises(DagError, match="itself"):
+            parse_workflow(
+                {"stages": [{"name": "a", "op": "echo", "after": ["a"]}]},
+                KNOWN,
+            )
+
+    def test_stage_and_width_limits(self):
+        many = {"stages": [
+            {"name": f"s{i}", "op": "echo"} for i in range(5)
+        ]}
+        with pytest.raises(DagError, match="FLOW_MAX_STAGES"):
+            parse_workflow(many, KNOWN, max_stages=4)
+        wide = {"stages": [{"name": "a", "op": "echo", "fan_out": 9}]}
+        with pytest.raises(DagError, match="FLOW_MAX_WIDTH"):
+            parse_workflow(wide, KNOWN, max_width=8)
+        with pytest.raises(DagError):
+            parse_workflow(
+                {"stages": [{"name": "a", "op": "echo", "fan_out": True}]},
+                KNOWN,
+            )
+
+    def test_critical_path_linear_is_strictly_decreasing(self):
+        doc = {"stages": [
+            {"name": "s0", "op": "echo"},
+            {"name": "s1", "op": "echo", "after": ["s0"]},
+            {"name": "s2", "op": "echo", "after": ["s1"]},
+        ]}
+        cp = critical_path_lengths(parse_workflow(doc, KNOWN))
+        assert cp == {"s0": 3, "s1": 2, "s2": 1}
+
+    def test_expand_fan_in_lists_every_upstream_instance(self):
+        spec = parse_workflow(FANOUT_DOC, KNOWN)
+        planned = {p.job_id: p for p in expand_workflow(spec, "wf-x")}
+        acc = planned["wf-x-acc"]
+        assert acc.after == ("wf-x-cls-0", "wf-x-cls-1", "wf-x-cls-2")
+        assert acc.payload["__collect_partials__"] is True
+        cls0 = planned["wf-x-cls-0"]
+        assert cls0.payload["fan_index"] == 0
+        assert cls0.payload["fan_out"] == 3
+        assert cls0.after == ("wf-x-tok",)
+        assert cls0.critical_path == 3 and acc.critical_path == 2
+        assert planned["wf-x-rep"].critical_path == 1
+        assert planned["wf-x-tok"].critical_path == 4
+
+
+# ---------------------------------------------------------------------------
+# critical-path-first scheduling
+# ---------------------------------------------------------------------------
+
+
+class TestCriticalPathFirst:
+    def test_linear_graphs_drain_exactly_like_plain_fifo(self):
+        """Property test (seeded, the ISSUE 19 pin): for a LINEAR graph the
+        critical-path sort is a no-op. Along a chain cp strictly decreases
+        in submit order, and a chain stage is only ever eligible after every
+        earlier stage finished, so at each decision point the eligible job
+        with the highest cp is also the earliest arrival — drain order is
+        bit-identical to plain FIFO (== submit order). Plain-only workloads
+        (all cp == 0) pin the stable-sort identity half of the claim."""
+        rng = random.Random(7)
+        for trial in range(20):
+            c = Controller(flow=FlowConfig(cache_enabled=False))
+            expected = []  # job ids in submit order == plain-FIFO order
+            if rng.random() < 0.7:
+                depth = rng.randint(1, 6)
+                doc = {"stages": [
+                    {"name": f"s{i}", "op": "echo",
+                     "payload": {"i": i}, "collect": False,
+                     **({"after": [f"s{i-1}"]} if i else {})}
+                    for i in range(depth)
+                ]}
+                expected.extend(c.submit_workflow(doc)["job_ids"])
+            for j in range(rng.randint(0, 6)):
+                expected.append(c.submit("echo", {"j": j}))
+            drained = []
+            for _ in range(len(expected)):
+                lease = c.lease("a1", {"ops": ["echo"]}, max_tasks=1)
+                if lease is None:
+                    break
+                for t in lease["tasks"]:
+                    drained.append(t["id"])
+                    c.report(lease["lease_id"], t["id"], t["job_epoch"],
+                             "succeeded", result={"ok": True})
+            assert drained == expected, f"trial {trial}"
+
+    def test_deep_dag_preempts_shallow_plain_jobs(self):
+        """With mixed work queued, the stage with the most downstream work
+        leases first even though it arrived last — under fifo AND fair."""
+        for policy in ("fifo", "fair"):
+            c = Controller(
+                sched=SchedConfig(policy=policy),
+                flow=FlowConfig(cache_enabled=False),
+            )
+            plain = [c.submit("echo", {"p": i}) for i in range(3)]
+            out = c.submit_workflow({"stages": [
+                {"name": "deep0", "op": "echo", "collect": False},
+                {"name": "deep1", "op": "echo", "after": ["deep0"],
+                 "collect": False},
+                {"name": "deep2", "op": "echo", "after": ["deep1"],
+                 "collect": False},
+            ]})
+            lease = c.lease("a1", {"ops": ["echo"]}, max_tasks=1)
+            first = lease["tasks"][0]["id"]
+            assert first == out["job_ids"][0], policy
+            assert first not in plain
+
+
+# ---------------------------------------------------------------------------
+# ResultCache unit surface
+# ---------------------------------------------------------------------------
+
+
+class TestResultCache:
+    def test_key_is_order_insensitive_and_version_sensitive(self):
+        k1 = result_key("echo", {"a": 1, "b": 2}, "v1")
+        k2 = result_key("echo", {"b": 2, "a": 1}, "v1")
+        assert k1 == k2
+        assert result_key("echo", {"a": 1, "b": 2}, "v2") != k1
+        assert result_key("other", {"a": 1, "b": 2}, "v1") != k1
+
+    def test_lru_eviction(self):
+        rc = ResultCache(capacity=2)
+        rc.put("echo", {"k": 1}, {"r": 1})
+        rc.put("echo", {"k": 2}, {"r": 2})
+        assert rc.get("echo", {"k": 1}) == {"r": 1}   # 1 now MRU
+        rc.put("echo", {"k": 3}, {"r": 3})            # evicts 2
+        assert rc.get("echo", {"k": 2}) is None
+        assert rc.get("echo", {"k": 1}) == {"r": 1}
+        assert rc.stats()["evictions"] == 1
+
+    def test_model_version_bump_invalidates(self):
+        rc = ResultCache(capacity=8, model_version="v1")
+        rc.put("echo", {"k": 1}, {"r": 1})
+        rc.set_model_version("v2")
+        assert rc.get("echo", {"k": 1}) is None
+        assert rc.stats()["invalidations"] == 1
+        rc.put("echo", {"k": 1}, {"r": "new"})
+        assert rc.get("echo", {"k": 1}) == {"r": "new"}
+
+    def test_stored_results_are_isolated_copies(self):
+        rc = ResultCache(capacity=8)
+        src = {"rows": [1, 2]}
+        rc.put("echo", {"k": 1}, src)
+        src["rows"].append(3)
+        out = rc.get("echo", {"k": 1})
+        assert out == {"rows": [1, 2]}
+        out["rows"].append(9)
+        assert rc.get("echo", {"k": 1}) == {"rows": [1, 2]}
+
+    def test_capacity_zero_disables(self):
+        rc = ResultCache(capacity=0)
+        assert not rc.enabled
+        rc.put("echo", {"k": 1}, {"r": 1})
+        assert rc.get("echo", {"k": 1}) is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: submit -> drain -> status -> cache-hit resubmission
+# ---------------------------------------------------------------------------
+
+
+class TestWorkflowEndToEnd:
+    def test_fanout_fanin_drains_with_single_trace_tree(self):
+        c = Controller()
+        out = c.submit_workflow(FANOUT_DOC, tenant="acme", priority=6)
+        wid = out["workflow_id"]
+        assert out["stages"] == ["tok", "cls", "acc", "rep"]
+        assert len(out["job_ids"]) == 6
+        drain(c)
+        wj = c.workflow_json(wid)
+        assert wj["state"] == "succeeded"
+        assert wj["terminal_jobs"] == wj["total_jobs"] == 6
+        assert wj["failed_jobs"] == 0
+        # the fan-in landed the 3 shard results as ordered partials
+        (rep_result,) = wj["results"].values()
+        assert rep_result["partials"] == [{"n": 3}]
+        # ONE trace tree: a single root span named "workflow", every other
+        # span parented (transitively) under it, all under trace_id == wid
+        spans = c.traces.spans(wid)
+        roots = [s for s in spans if not s.get("parent_span_id")]
+        assert len(roots) == 1 and roots[0]["name"] == "workflow"
+        ids = {s["span_id"] for s in spans}
+        assert all(
+            s["parent_span_id"] in ids
+            for s in spans if s.get("parent_span_id")
+        )
+        assert {"submit", "lease", "apply"} <= {s["name"] for s in spans}
+
+    def test_unknown_workflow_returns_none(self):
+        assert Controller().workflow_json("wf-nope") is None
+
+    def test_second_identical_submission_hits_cache(self):
+        c = Controller()
+        first = c.submit_workflow(FANOUT_DOC)
+        drain(c)
+        wj1 = c.workflow_json(first["workflow_id"])
+        second = c.submit_workflow(FANOUT_DOC)
+        leases = drain(c)
+        wj2 = c.workflow_json(second["workflow_id"])
+        assert wj2["state"] == "succeeded"
+        # ≥90% of the second submission served from cache (here: all of it,
+        # so it drained with no agent leases at all)
+        assert wj2["cache_hits"] >= 0.9 * wj2["total_jobs"]
+        assert leases == 0
+        # byte-identical results
+        assert json.dumps(list(wj1["results"].values()), sort_keys=True) \
+            == json.dumps(list(wj2["results"].values()), sort_keys=True)
+        # dedupe ratio visible in the usage report
+        usage = c.usage_json()
+        assert usage["totals"]["result_cache_hits"] == wj2["cache_hits"]
+        assert usage["totals"]["result_dedupe_ratio"] is not None
+        by_tenant = usage["by_tenant"]["default"]
+        assert by_tenant["result_dedupe_ratio"] is not None
+        # cache-hit jobs billed at cache price
+        stats = c.workflows_json()["result_cache"]
+        assert stats["hits"] == wj2["cache_hits"]
+
+    def test_cache_disabled_by_config(self):
+        c = Controller(flow=FlowConfig(cache_enabled=False))
+        c.submit_workflow(FANOUT_DOC)
+        drain(c)
+        c.submit_workflow(FANOUT_DOC)
+        leases = drain(c)
+        assert leases > 0
+        assert c.workflows_json()["result_cache"] is None
+
+    def test_flow_disabled_raises(self):
+        c = Controller(flow=FlowConfig(enabled=False))
+        with pytest.raises(RuntimeError, match="FLOW_ENABLED"):
+            c.submit_workflow(FANOUT_DOC)
+
+    def test_dependency_failed_cascade_kills_downstream(self):
+        c = Controller(max_attempts=1)
+        out = c.submit_workflow(FANOUT_DOC)
+        drain(c, status="failed", rounds=1)
+        wj = c.workflow_json(out["workflow_id"])
+        assert wj["state"] == "dead"
+        assert wj["terminal_jobs"] == wj["total_jobs"]
+        counts = {s["name"]: s["counts"] for s in wj["stages"]}
+        assert counts["tok"] == {"failed": 1}
+        assert counts["cls"] == {"dead": 3}
+        assert counts["acc"] == {"dead": 1}
+        assert counts["rep"] == {"dead": 1}
+        dead = c.job_snapshot(out["job_ids"][-1])
+        assert dead["error"]["type"] == "DependencyFailed"
+
+
+# ---------------------------------------------------------------------------
+# journal replay
+# ---------------------------------------------------------------------------
+
+
+class TestWorkflowReplay:
+    def test_replay_mid_dag_resumes_to_identical_output(self, tmp_path):
+        jp = os.fspath(tmp_path / "journal.jsonl")
+        c = Controller(journal_path=jp)
+        out = c.submit_workflow(FANOUT_DOC)
+        wid = out["workflow_id"]
+        # drain only tok + the 3 cls shards, then "crash"
+        for _ in range(2):
+            lease = c.lease("a1", {"ops": ["echo"]}, max_tasks=4)
+            for t in lease["tasks"]:
+                c.report(lease["lease_id"], t["id"], t["job_epoch"],
+                         "succeeded",
+                         result={k: v for k, v in t["payload"].items()
+                                 if k != "__collect_partials__"})
+        c.close()
+        c2 = Controller(journal_path=jp)
+        wj = c2.workflow_json(wid)
+        assert wj["state"] == "running"
+        assert wj["terminal_jobs"] == 4
+        assert wj["critical_stage"] == "acc"
+        drain(c2)
+        wj = c2.workflow_json(wid)
+        assert wj["state"] == "succeeded"
+        (rep_result,) = wj["results"].values()
+        assert rep_result == {"final": True, "partials": [{"n": 3}]}
+        # the replayed incarnation reopened ONE workflow trace root
+        spans = c2.traces.spans(wid)
+        roots = [s for s in spans if not s.get("parent_span_id")]
+        assert len(roots) == 1 and roots[0]["name"] == "workflow"
+
+    def test_replayed_cache_hits_stay_bit_identical(self, tmp_path):
+        """A journal holding cache-hit terminal events replays to the same
+        terminal results, byte for byte."""
+        jp = os.fspath(tmp_path / "journal.jsonl")
+        c = Controller(journal_path=jp)
+        a = c.submit_workflow(FANOUT_DOC)
+        drain(c)
+        b = c.submit_workflow(FANOUT_DOC)   # all from cache
+        drain(c)
+        want_a = c.workflow_json(a["workflow_id"])
+        want_b = c.workflow_json(b["workflow_id"])
+        assert want_b["cache_hits"] == want_b["total_jobs"]
+        c.close()
+        c2 = Controller(journal_path=jp)
+        got_a = c2.workflow_json(a["workflow_id"])
+        got_b = c2.workflow_json(b["workflow_id"])
+        for want, got in ((want_a, got_a), (want_b, got_b)):
+            assert got["state"] == "succeeded"
+            assert got["cache_hits"] == want["cache_hits"]
+            assert json.dumps(got["results"], sort_keys=True) \
+                == json.dumps(want["results"], sort_keys=True)
+
+    def test_replay_ordering_regression_upstream_failed_last_record(
+        self, tmp_path
+    ):
+        """THE replay-ordering bug (ISSUE 19 satellite): a crash lands
+        between the upstream's terminal-failure record and the cascade's
+        records. Replay must not strand the dep-gated dependent in PENDING —
+        ``_finalize_replay_locked`` re-runs the cascade."""
+        jp = os.fspath(tmp_path / "journal.jsonl")
+        c = Controller(journal_path=jp, max_attempts=1)
+        out = c.submit_workflow({"stages": [
+            {"name": "up", "op": "echo", "payload": {}},
+            {"name": "down", "op": "risk_accumulate", "payload": {},
+             "after": ["up"]},
+        ]})
+        lease = c.lease("a1", {"ops": ["echo"]}, max_tasks=1)
+        t = lease["tasks"][0]
+        c.report(lease["lease_id"], t["id"], t["job_epoch"], "failed",
+                 error={"type": "ValueError", "message": "boom",
+                        "trace": ""})
+        c.close()
+        # drop every journal record after the upstream's failure
+        lines = open(jp).read().splitlines()
+        keep = []
+        for ln in lines:
+            keep.append(ln)
+            ev = json.loads(ln)
+            if ev.get("ev") == "result" and ev["job_id"].endswith("-up"):
+                break
+        assert len(keep) < len(lines)  # the cascade record WAS journaled
+        with open(jp, "w") as f:
+            f.write("\n".join(keep) + "\n")
+        c2 = Controller(journal_path=jp)
+        wj = c2.workflow_json(out["workflow_id"])
+        assert wj["state"] == "dead"
+        assert wj["terminal_jobs"] == 2
+        snap = c2.job_snapshot(out["job_ids"][1])
+        assert snap["state"] == "dead"
+        assert snap["error"]["type"] == "DependencyFailed"
+        # and nothing is left leasable
+        assert c2.lease("a1", {"ops": ["echo", "risk_accumulate"]}) is None
+
+    def test_dep_gated_job_rearms_after_replayed_upstream_success(
+        self, tmp_path
+    ):
+        """The companion direction: upstream SUCCEEDED in the journal —
+        after replay the dependent must lease (with partials materialized),
+        not sit stranded."""
+        jp = os.fspath(tmp_path / "journal.jsonl")
+        c = Controller(journal_path=jp)
+        c.submit_workflow({"stages": [
+            {"name": "up", "op": "echo", "payload": {"v": 7}},
+            {"name": "down", "op": "risk_accumulate", "payload": {},
+             "after": ["up"]},
+        ]})
+        lease = c.lease("a1", {"ops": ["echo"]}, max_tasks=1)
+        t = lease["tasks"][0]
+        c.report(lease["lease_id"], t["id"], t["job_epoch"], "succeeded",
+                 result={"v": 7})
+        c.close()
+        c2 = Controller(journal_path=jp, flow=FlowConfig(cache_enabled=False))
+        lease = c2.lease("a1", {"ops": ["risk_accumulate"]}, max_tasks=4)
+        assert lease is not None and len(lease["tasks"]) == 1
+        assert lease["tasks"][0]["payload"]["partials"] == [{"v": 7}]
+
+
+# ---------------------------------------------------------------------------
+# LoopbackSession route (the HTTP dispatch minus sockets)
+# ---------------------------------------------------------------------------
+
+
+class TestLoopbackWorkflows:
+    def test_submit_and_error_mapping(self):
+        c = Controller()
+        s = LoopbackSession(c)
+        resp = s.post("http://c/v1/workflows", json=dict(
+            FANOUT_DOC, tenant="acme", priority=4,
+        ))
+        assert resp.status_code == 200
+        body = resp.json()
+        assert body["workflow_id"].startswith("wf-")
+        assert len(body["job_ids"]) == 6
+        drain(c)
+        assert c.workflow_json(body["workflow_id"])["state"] == "succeeded"
+
+        bad = s.post("http://c/v1/workflows", json={"stages": [
+            {"name": "a", "op": "echo", "after": ["a"]},
+        ]})
+        assert bad.status_code == 400
+
+        off = LoopbackSession(Controller(flow=FlowConfig(enabled=False)))
+        resp = off.post("http://c/v1/workflows", json=FANOUT_DOC)
+        assert resp.status_code == 501
+
+
+# ---------------------------------------------------------------------------
+# /v1/infer front-door cache
+# ---------------------------------------------------------------------------
+
+
+class TestInferFrontDoorCache:
+    def test_identical_request_served_from_cache(self):
+        from agent_tpu.config import ServeConfig
+        from tests.test_serving import TINY_CLS, _drain_serving
+
+        c = Controller(serve=ServeConfig(max_wait_ms=0.0, max_batch=4))
+        params = {"model_config": TINY_CLS, "topk": 2}
+        rid1 = c.submit_infer("classify", "cache this text", params=params)
+        c._serve_pump()
+        _drain_serving(c)
+        c._serve_reap()
+        snap1 = c.infer_snapshot(rid1)
+        assert snap1["state"] == "done"
+
+        # identical resubmission: done at submit time, no job, no drain
+        rid2 = c.submit_infer("classify", "cache this text", params=params)
+        snap2 = c.infer_snapshot(rid2)
+        assert snap2["state"] == "done"
+        assert snap2["job_id"] is None
+        assert snap2["result"] == snap1["result"]
+        assert snap2["ttft_ms"] == 0.0
+        usage = c.usage_json()
+        assert usage["totals"]["result_cache_hits"] == 1
+
+        # different text misses
+        rid3 = c.submit_infer("classify", "different text", params=params)
+        assert c.infer_snapshot(rid3)["state"] != "done"
+
+
+# ---------------------------------------------------------------------------
+# loadgen zipfian payload mix
+# ---------------------------------------------------------------------------
+
+
+class TestZipfPayloads:
+    def test_zipf_rank_seeded_and_head_heavy(self):
+        rng = random.Random(3)
+        draws = [zipf_rank(rng, 50, 1.1) for _ in range(2000)]
+        again = [zipf_rank(random.Random(3), 50, 1.1)]
+        assert draws[0] == again[0]
+        counts = {}
+        for d in draws:
+            counts[d] = counts.get(d, 0) + 1
+        assert counts.get(0, 0) > counts.get(10, 0) > counts.get(40, 0)
+        assert max(draws) < 50 and min(draws) >= 0
+        # s=0 is uniform-ish: rank 0 no longer dominates
+        flat = [zipf_rank(random.Random(3), 50, 0.0) for _ in range(2000)]
+        fc = {}
+        for d in flat:
+            fc[d] = fc.get(d, 0) + 1
+        assert fc.get(0, 0) < 3 * (2000 / 50)
+
+    def test_traffic_class_zipf_payloads_recur_byte_identical(self):
+        cls = TrafficClass(
+            name="z", op="echo", payload={"base": 1}, payload_zipf_s=1.2,
+            payload_pool=8,
+        )
+        rng = random.Random(11)
+        payloads = [cls.build_payload(rng, i) for i in range(200)]
+        variants = {p["variant"] for p in payloads}
+        assert variants <= set(range(8)) and len(variants) > 1
+        by_variant = {}
+        for p in payloads:
+            by_variant.setdefault(p["variant"], set()).add(
+                json.dumps(p, sort_keys=True)
+            )
+        assert all(len(v) == 1 for v in by_variant.values())
+
+    def test_payload_fn_becomes_pure_function_of_rank(self):
+        def fn(rng, rank):
+            return {"rank": rank, "noise": rng.random()}
+
+        cls = TrafficClass(
+            name="z", op="echo", payload_fn=fn, payload_zipf_s=1.0,
+            payload_pool=4,
+        )
+        rng = random.Random(5)
+        seen = {}
+        for i in range(100):
+            p = cls.build_payload(rng, i)
+            key = p["rank"]
+            if key in seen:
+                assert seen[key] == p
+            seen[key] = p

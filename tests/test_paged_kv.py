@@ -366,7 +366,15 @@ class TestDisaggServing:
         reset_engines()   # fresh engine store + prefix cache per test
         defaults = dict(max_wait_ms=0.0, max_batch=4)
         defaults.update(kw)
-        return Controller(serve=ServeConfig(**defaults))
+        # Result cache off (ISSUE 19): these tests exercise the KV-layer
+        # prefix cache, which the front-door result cache would mask on
+        # repeated identical requests.
+        from agent_tpu.config import FlowConfig
+
+        return Controller(
+            serve=ServeConfig(**defaults),
+            flow=FlowConfig(cache_enabled=False),
+        )
 
     def test_colocated_prefix_cache_hit_bit_identical(self):
         """The satellite bar: a prefix-cache hit returns output

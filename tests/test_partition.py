@@ -276,6 +276,65 @@ def test_route_submit_csv_places_whole_bulk_by_source_uri():
     assert [c[0] for c in stub.calls] == [want] * 3
 
 
+def test_route_workflow_places_whole_dag_by_graph_id():
+    """ISSUE 19: every stage of a DAG lands on ONE partition, keyed by the
+    graph id — the same whole-unit rule CSV bulk submits use for
+    ``source_uri``. Dep edges never span partitions."""
+    core, stub = make_core()
+    doc = {
+        "tenant": "acme", "workflow_id": "wf-fixed",
+        "stages": [{"name": "tok", "op": "echo", "payload": {}}],
+    }
+    want = core.pmap.ring.place(placement_key("acme", "wf\x1fwf-fixed"))
+    for _ in range(3):
+        status, parsed = core.route_workflow(dict(doc))
+        assert status == 200
+        assert parsed["partition"] == want
+    assert [c[0] for c in stub.calls] == [want] * 3
+    assert all(c[2] == "/v1/workflows" for c in stub.calls)
+    assert core.counters["submits_total"] == 3
+
+
+def test_route_workflow_mints_graph_id_and_resubmit_sticks():
+    core, stub = make_core()
+    status, _ = core.route_workflow(
+        {"stages": [{"name": "a", "op": "echo", "payload": {}}]}
+    )
+    assert status == 200
+    name, _, _, body = stub.calls[0]
+    assert body["workflow_id"].startswith("wf-")  # router minted the id
+    stub.calls.clear()
+    core.route_workflow({"workflow_id": body["workflow_id"], "stages": []})
+    assert stub.calls[0][0] == name
+
+
+def test_stolen_dag_stage_lease_still_tagged_with_owner_partition():
+    """Work stealing is unchanged by DAG placement: an agent homed off the
+    DAG's owner partition can steal its stages, and the lease id carries
+    the OWNER's tag so the result routes back to the partition holding the
+    workflow state."""
+    core, stub = make_core()
+    owner = core.pmap.ring.place(placement_key("acme", "wf\x1fwf-steal"))
+    core.route_workflow({
+        "tenant": "acme", "workflow_id": "wf-steal",
+        "stages": [{"name": "tok", "op": "echo", "payload": {}}],
+    })
+    agent = next(
+        f"w{i}" for i in range(100)
+        if core.home_for_agent(f"w{i}") != owner
+    )
+    home = core.home_for_agent(agent)
+    stub.depths.update({n: 0 for n in core.pmap.names})
+    stub.depths[owner] = 3
+    stub.responses[(home, "/v1/leases")] = lambda body: (204, None)
+    stub.responses[(owner, "/v1/leases")] = lambda body: (
+        200, {"lease_id": "lease-wf", "tasks": [{"id": "wf-steal-tok"}]}
+    )
+    status, lease = core.route_lease({"agent": agent, "max_tasks": 1})
+    assert status == 200
+    assert lease["lease_id"] == f"{owner}!lease-wf"
+
+
 def test_route_submit_429_passes_through_with_partition_stamp():
     core, stub = make_core()
     jid = job_id_for_partition(core.pmap.ring, "p1", prefix="bp")
