@@ -488,6 +488,27 @@ class ObsConfig:
     tsdb_enabled: bool = True              # TSDB_ENABLED
     tsdb_window_sec: float = 900.0         # TSDB_WINDOW
     tsdb_interval_sec: float = 10.0        # TSDB_INTERVAL
+    # Durable on-disk store (ISSUE 20): "" keeps the ring in-memory only;
+    # a directory persists every sample with tiered downsampling.
+    tsdb_dir: str = ""                     # TSDB_DIR
+    tsdb_segment_bytes: int = 1 << 20      # TSDB_SEGMENT_BYTES
+    tsdb_retention_raw_sec: float = 3600.0      # TSDB_RETENTION_RAW_SEC
+    tsdb_retention_1m_sec: float = 86400.0      # TSDB_RETENTION_1M_SEC
+    tsdb_retention_10m_sec: float = 604800.0    # TSDB_RETENTION_10M_SEC
+    tsdb_max_bytes: int = 256 << 20        # TSDB_MAX_BYTES (0 = uncapped)
+    # Rolling-baseline anomaly detection over the sample stream.
+    anomaly_enabled: bool = True           # ANOMALY_ENABLED
+    anomaly_window: int = 60               # ANOMALY_WINDOW (baseline n)
+    anomaly_warmup: int = 12               # ANOMALY_WARMUP (gate)
+    anomaly_z: float = 8.0                 # ANOMALY_Z (MAD z threshold)
+    anomaly_confirm: int = 2               # ANOMALY_CONFIRM (consecutive)
+    anomaly_clear: int = 5                 # ANOMALY_CLEAR (episode close)
+    # Incident forensics bundles (GET /v1/incidents).
+    incident_enabled: bool = True          # INCIDENT_ENABLED
+    incident_dir: str = ""                 # INCIDENT_DIR ("" = memory only)
+    incident_capacity: int = 32            # INCIDENT_CAPACITY
+    incident_min_interval_sec: float = 60.0  # INCIDENT_MIN_INTERVAL_SEC
+    incident_worst_k: int = 3              # INCIDENT_WORST_K (traces kept)
     # Host sampling profiler (GET /v1/profile/host): collapsed-stack
     # flamegraph of the controller process, lazily started.
     profile_host_enabled: bool = True      # PROFILE_HOST_ENABLED
@@ -511,6 +532,33 @@ class ObsConfig:
                 interval, env_float("TSDB_WINDOW", 900.0)
             ),
             tsdb_interval_sec=interval,
+            tsdb_dir=env_str("TSDB_DIR", "").strip(),
+            tsdb_segment_bytes=max(
+                4096, env_int("TSDB_SEGMENT_BYTES", 1 << 20)
+            ),
+            tsdb_retention_raw_sec=max(
+                0.0, env_float("TSDB_RETENTION_RAW_SEC", 3600.0)
+            ),
+            tsdb_retention_1m_sec=max(
+                0.0, env_float("TSDB_RETENTION_1M_SEC", 86400.0)
+            ),
+            tsdb_retention_10m_sec=max(
+                0.0, env_float("TSDB_RETENTION_10M_SEC", 604800.0)
+            ),
+            tsdb_max_bytes=max(0, env_int("TSDB_MAX_BYTES", 256 << 20)),
+            anomaly_enabled=env_bool("ANOMALY_ENABLED", True),
+            anomaly_window=max(4, env_int("ANOMALY_WINDOW", 60)),
+            anomaly_warmup=max(2, env_int("ANOMALY_WARMUP", 12)),
+            anomaly_z=max(1.0, env_float("ANOMALY_Z", 8.0)),
+            anomaly_confirm=max(1, env_int("ANOMALY_CONFIRM", 2)),
+            anomaly_clear=max(1, env_int("ANOMALY_CLEAR", 5)),
+            incident_enabled=env_bool("INCIDENT_ENABLED", True),
+            incident_dir=env_str("INCIDENT_DIR", "").strip(),
+            incident_capacity=max(1, env_int("INCIDENT_CAPACITY", 32)),
+            incident_min_interval_sec=max(
+                0.0, env_float("INCIDENT_MIN_INTERVAL_SEC", 60.0)
+            ),
+            incident_worst_k=max(0, env_int("INCIDENT_WORST_K", 3)),
             profile_host_enabled=env_bool("PROFILE_HOST_ENABLED", True),
             profile_host_hz=max(0.1, env_float("PROFILE_HOST_HZ", 19.0)),
             profile_capture_dir=env_str("PROFILE_CAPTURE_DIR", "").strip(),

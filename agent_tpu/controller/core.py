@@ -92,9 +92,12 @@ from agent_tpu.controller.serving import (
     ServeFrontDoor,
 )
 from agent_tpu.data import wire
+from agent_tpu.obs.anomaly import AnomalyDetector
 from agent_tpu.obs.health import build_health
+from agent_tpu.obs.incident import IncidentBundler
 from agent_tpu.obs.profile import CaptureCoordinator, HostProfiler
 from agent_tpu.obs.timeseries import TimeSeriesRing
+from agent_tpu.obs.tsdb import TsdbStore, query_history
 from agent_tpu.obs.usage import UsageLedger
 from agent_tpu.obs.metrics import (
     MetricsRegistry,
@@ -260,6 +263,7 @@ class Controller:
         serve: Optional[ServeConfig] = None,
         partition: Optional[str] = None,
         flow: Optional[FlowConfig] = None,
+        tsdb_defer_open: bool = False,
     ) -> None:
         self.lease_ttl_sec = lease_ttl_sec
         # Partitioned control plane (ISSUE 18): this controller's partition
@@ -456,6 +460,34 @@ class Controller:
                 interval_sec=self.obs_config.tsdb_interval_sec,
                 clock=self._clock,
             )
+        # Durable telemetry vertical (ISSUE 20): on-disk store + anomaly
+        # detector + incident bundler, all riding the ring's sample hook.
+        # A hot standby defers the store open (``tsdb_defer_open``) — two
+        # incarnations must never append to the same segment stream;
+        # ``finalize_promotion`` opens it when the replica takes over.
+        self.tsdb_store: Optional[TsdbStore] = None
+        self._tsdb_defer_open = bool(tsdb_defer_open)
+        self._tsdb_prev_sample: Optional[Dict[str, Any]] = None
+        self.anomaly: Optional[AnomalyDetector] = None
+        if self.obs_config.anomaly_enabled and self.tsdb is not None:
+            self.anomaly = AnomalyDetector(
+                window=self.obs_config.anomaly_window,
+                warmup=self.obs_config.anomaly_warmup,
+                z_thresh=self.obs_config.anomaly_z,
+                confirm=self.obs_config.anomaly_confirm,
+                clear=self.obs_config.anomaly_clear,
+            )
+        self.incidents: Optional[IncidentBundler] = None
+        if self.obs_config.incident_enabled:
+            self.incidents = IncidentBundler(
+                directory=self.obs_config.incident_dir,
+                capacity=self.obs_config.incident_capacity,
+                min_interval_sec=self.obs_config.incident_min_interval_sec,
+            )
+        if self.tsdb is not None:
+            if not self._tsdb_defer_open:
+                self._open_tsdb_store()
+            self.tsdb.on_sample = self._on_tsdb_sample
         # Online-serving front door (ISSUE 15): POST /v1/infer requests
         # coalesce into length-bucketed interactive-tier batch jobs.
         # SERVE_ENABLED=0 leaves the door None and 501s the route.
@@ -683,6 +715,18 @@ class Controller:
             log("slo page — flight recorder dumped", path=path, events=n)
         except OSError:
             pass  # a failing dump must not take down the control plane
+        # Incident forensics (ISSUE 20): page entry snapshots one
+        # correlated bundle (the dump above folds in via slo_dumps).
+        self._capture_incident(
+            "slo_page",
+            str(result.get("objective")),
+            {
+                "objective": result.get("objective"),
+                "burn_short": result.get("burn_rate_short"),
+                "burn_long": result.get("burn_rate_long"),
+                **selector,
+            },
+        )
 
     def _slo_observe_locked(self, job: Job, now: float) -> None:
         """Feed one terminal job into the SLO tracker: submit→apply latency
@@ -750,6 +794,9 @@ class Controller:
             agents=agents,
             agent_stale_sec=self.slo_config.agent_stale_sec,
             partition=self.partition,
+            anomalies=(
+                self.anomaly.active() if self.anomaly is not None else ()
+            ),
         )
 
     @property
@@ -1266,6 +1313,11 @@ class Controller:
         self._m_promotions.inc()
         self.recorder.record("promotion", path=impl.path)
         log("standby promoted to primary", journal=impl.path)
+        # Durable telemetry (ISSUE 20): the replica deferred the tsdb
+        # store open (the dead primary owned the segment streams); the
+        # promoted incarnation reopens them — open_for_append seals any
+        # torn tail — so pre-kill history stays queryable after failover.
+        self._open_tsdb_store()
         if sweep_interval_sec:
             self.start_sweeper(sweep_interval_sec)
 
@@ -1309,11 +1361,159 @@ class Controller:
     def _tsdb_sample(self) -> None:
         """Rate-limited time-series sample (controller registry + fleet
         merge). Runs OUTSIDE the controller lock — fleet_snapshot takes it —
-        and costs one clock read when no sample is due."""
+        and costs one clock read when no sample is due. The ring's
+        ``on_sample`` hook fans each recorded sample out to the durable
+        store and the anomaly detector (ISSUE 20)."""
         if self.tsdb is not None:
             self.tsdb.maybe_sample(
                 lambda: (self.metrics.snapshot(), self.fleet_snapshot())
             )
+
+    # ---- durable telemetry / anomaly / incidents (ISSUE 20) ----
+
+    def _open_tsdb_store(self) -> None:
+        """Open (or reopen after promotion) the on-disk store. Idempotent;
+        a failed open degrades to ring-only telemetry, never a crash."""
+        if (
+            self.tsdb is None
+            or not self.obs_config.tsdb_dir
+            or self.tsdb_store is not None
+        ):
+            return
+        try:
+            self.tsdb_store = TsdbStore(
+                self.obs_config.tsdb_dir,
+                segment_max_bytes=self.obs_config.tsdb_segment_bytes,
+                retention_raw_sec=self.obs_config.tsdb_retention_raw_sec,
+                retention_1m_sec=self.obs_config.tsdb_retention_1m_sec,
+                retention_10m_sec=self.obs_config.tsdb_retention_10m_sec,
+                max_bytes=self.obs_config.tsdb_max_bytes,
+            )
+        except OSError as exc:
+            log("tsdb store open failed (ring-only telemetry)",
+                dir=self.obs_config.tsdb_dir, error=str(exc)[:200])
+
+    def _on_tsdb_sample(
+        self, wall: float, mono: float, data: Dict[str, Dict[str, float]]
+    ) -> None:
+        """Ring sample hook: persist to disk, score for anomalies, and
+        bundle an incident when one confirms. Runs on the sampling thread
+        (sweeper or lease path), outside the controller lock."""
+        if self.tsdb_store is not None:
+            self.tsdb_store.append_sample(wall, data)
+        if self.anomaly is None:
+            self._tsdb_prev_sample = {"wall": wall, "data": data}
+            return
+        sample = {"wall": wall, "data": data}
+        events = self.anomaly.observe(self._tsdb_prev_sample, sample)
+        self._tsdb_prev_sample = sample
+        for ev in events:
+            self.recorder.record("anomaly", **ev)
+            log("anomaly confirmed", watch=ev.get("watch"),
+                value=ev.get("value"), z=ev.get("z"))
+            self._capture_incident(
+                "anomaly", str(ev.get("watch")), dict(ev)
+            )
+
+    def _capture_incident(
+        self, kind: str, key: str, reason: Dict[str, Any]
+    ) -> None:
+        """Snapshot one correlated forensics bundle: the telemetry window
+        around the event, flight-recorder tail + today's SLO dumps, the
+        reqlog slow tail, traces of the K worst requests, status and
+        health. Bounded and content-addressed by the bundler; dedup and
+        rate-limiting happen there too."""
+        if self.incidents is None:
+            return
+        sections: Dict[str, Any] = {}
+        if self.tsdb is not None:
+            watched = [
+                "controller_queue_depth", "serve_ttft_seconds_sum",
+                "serve_ttft_seconds_count", "serve_kv_blocks_free",
+                "device_duty_cycle", "result_post_failures_total",
+                "controller_results_total",
+            ]
+            window: Dict[str, Any] = {}
+            for name in watched:
+                series = self.tsdb.series(name, window_sec=600.0)
+                if series:
+                    window[name] = series
+            sections["timeseries"] = window
+        sections["flight_recorder"] = self.recorder.events()[-200:]
+        if self.slo_dump_paths:
+            sections["slo_dumps"] = list(self.slo_dump_paths)[-8:]
+        worst: List[Dict[str, Any]] = []
+        if self.reqlog is not None:
+            slow = self.reqlog.snapshot(slow=True, limit=64)
+            sections["reqlog_slow"] = slow[:32]
+            worst = sorted(
+                (r for r in slow if isinstance(
+                    r.get("ttft_ms"), (int, float))),
+                key=lambda r: float(r["ttft_ms"]), reverse=True,
+            )[: self.obs_config.incident_worst_k]
+        if worst:
+            traces = []
+            for rec in worst:
+                req_id = rec.get("req_id")
+                if not req_id:
+                    continue
+                doc = self.traces.assemble(str(req_id))
+                if doc is not None:
+                    traces.append(doc)
+            if traces:
+                sections["worst_request_traces"] = traces
+        sections["status"] = {
+            "counts": self.counts(),
+            "queue_depth": self.queue_depth(),
+            "journal": self.journal_status(),
+            "promotions": self.promotions,
+            "partition": self.partition,
+        }
+        try:
+            sections["health"] = self.health_json()
+        except Exception:  # noqa: BLE001 — forensics best-effort
+            pass
+        bundle = self.incidents.capture(kind, key, reason, sections)
+        if bundle is not None:
+            self.recorder.record(
+                "incident", id=bundle["id"], trigger=kind, key=key,
+            )
+            log("incident bundle captured", id=bundle["id"], kind=kind,
+                key=key)
+
+    def incidents_json(self, incident_id: Optional[str] = None) -> \
+            Dict[str, Any]:
+        """The ``GET /v1/incidents{,/id}`` body."""
+        if self.incidents is None:
+            if incident_id is not None:
+                return {"enabled": False, "incident": None}
+            return {"enabled": False, "incidents": [], "stats": {}}
+        if incident_id is not None:
+            return {
+                "enabled": True,
+                "incident": self.incidents.get(incident_id),
+            }
+        return {
+            "enabled": True,
+            "incidents": self.incidents.list(),
+            "stats": self.incidents.stats(),
+        }
+
+    def timeseries_export_json(
+        self, since: float, limit: int = 2000
+    ) -> Dict[str, Any]:
+        """The ``GET /v1/timeseries/export`` body — raw ring samples
+        newer than ``since`` (the router collector's delta-scrape
+        cursor)."""
+        if self.tsdb is None:
+            return {"enabled": False, "samples": [], "now": time.time()}
+        return {
+            "enabled": True,
+            "samples": self.tsdb.samples_since(float(since), limit=limit),
+            "interval_sec": self.tsdb.interval_sec,
+            "partition": self.partition,
+            "now": time.time(),
+        }
 
     def start_sweeper(self, interval_sec: float = 5.0) -> None:
         """TTL enforcement without traffic: a daemon thread sweeping every
@@ -1340,6 +1540,8 @@ class Controller:
         if self._sweeper is not None:
             self._sweeper.join(timeout=5)
             self._sweeper = None
+        if self.tsdb_store is not None:
+            self.tsdb_store.close()
         with self._lock:
             if self._journal_impl is not None:
                 self._journal_impl.close()
@@ -3661,14 +3863,26 @@ class Controller:
         label_filter: Optional[Dict[str, str]] = None,
         rate: bool = False,
         window_sec: Optional[float] = None,
+        since: Optional[float] = None,
+        step: Optional[float] = None,
     ) -> Dict[str, Any]:
         """The ``GET /v1/timeseries`` body. Unknown names and an empty ring
-        return an empty ``series`` list, never an error."""
+        return an empty ``series`` list, never an error. ``since``/``step``
+        (ISSUE 20) switch to the historical view: the durable store when
+        one is open (it holds every ring sample and survives restarts),
+        the ring's bounded window otherwise — seamless either way."""
         if self.tsdb is None:
             return {"enabled": False, "name": name, "series": []}
-        out = self.tsdb.query(
-            name, label_filter, rate=rate, window_sec=window_sec
-        )
+        if since is not None or step is not None:
+            out = query_history(
+                name, label_filter=label_filter, rate=rate,
+                since=since, step=step,
+                ring=self.tsdb, store=self.tsdb_store,
+            )
+        else:
+            out = self.tsdb.query(
+                name, label_filter, rate=rate, window_sec=window_sec
+            )
         out["enabled"] = True
         return out
 

@@ -43,6 +43,7 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
 
 from agent_tpu.controller.partition import (
     LocalPartitionSet,
@@ -51,6 +52,8 @@ from agent_tpu.controller.partition import (
     RouterCore,
 )
 from agent_tpu.obs.metrics import parse_exposition
+from agent_tpu.obs.timeseries import TimeSeriesRing
+from agent_tpu.obs.tsdb import TsdbStore, query_history
 from agent_tpu.sched.steal import StealPolicy
 
 _VERDICT_RANK = {"ok": 0, "warn": 1, "page": 2}
@@ -397,12 +400,170 @@ def merge_metrics(
     return "\n".join(lines) + "\n"
 
 
+# ---- fleet telemetry collection (ISSUE 20 tentpole b) ----
+
+
+def _relabel_partition(
+    data: Dict[str, Any], partition: str
+) -> Dict[str, Dict[str, float]]:
+    """Inject ``partition=<name>`` into every series label key of one
+    scraped sample — the fleet store's series identity."""
+    out: Dict[str, Dict[str, float]] = {}
+    for fam, series in (data or {}).items():
+        if not isinstance(series, dict):
+            continue
+        dst = out.setdefault(fam, {})
+        for key, v in series.items():
+            try:
+                labels = [
+                    list(p) for p in json.loads(key)
+                    if isinstance(p, (list, tuple)) and len(p) == 2
+                    and p[0] != "partition"
+                ]
+            except ValueError:
+                continue
+            labels.append(["partition", partition])
+            dst[json.dumps(sorted(labels), separators=(",", ":"))] = \
+                float(v)
+    return out
+
+
+class FleetCollector:
+    """Scrapes each partition's ``/v1/timeseries/export`` deltas into one
+    fleet store (``partition``-labelled), so the router's
+    ``GET /v1/timeseries?since=`` answers fleet-wide historical queries —
+    the durable follow-up to the live-only fan-out merge. One wall-clock
+    cursor per partition; a partition restart resets its ring but not the
+    cursor (walls are wall-clock, so history never replays twice)."""
+
+    def __init__(
+        self,
+        pmap: PartitionMap,
+        interval_sec: float = 10.0,
+        window_sec: float = 900.0,
+        tsdb_dir: str = "",
+        timeout_sec: float = 5.0,
+        get_fn: Optional[Any] = None,
+    ) -> None:
+        self.pmap = pmap
+        self.interval_sec = max(0.25, float(interval_sec))
+        self.timeout_sec = timeout_sec
+        self.get_fn = get_fn if get_fn is not None else http_get_json
+        # The fleet ring holds len(pmap) partitions' samples per scrape
+        # round — size its slot budget accordingly.
+        self.ring = TimeSeriesRing(
+            window_sec=max(self.interval_sec, float(window_sec)),
+            interval_sec=self.interval_sec / max(1, len(pmap.names)),
+        )
+        self.store: Optional[TsdbStore] = None
+        if tsdb_dir:
+            self.store = TsdbStore(tsdb_dir)
+        self._cursors: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.scrapes = 0
+        self.scrape_errors = 0
+        self.samples_collected = 0
+
+    def collect_once(self) -> int:
+        """One scrape round across all partitions; returns samples
+        collected. Each partition's failover slots are tried in order —
+        a promoted standby keeps feeding the fleet view."""
+        collected = 0
+        for name in self.pmap.names:
+            cursor = self._cursors.get(name, 0.0)
+            doc = None
+            for url in self.pmap.urls(name):
+                try:
+                    status, parsed = self.get_fn(
+                        url,
+                        f"/v1/timeseries/export?since={cursor!r}",
+                        self.timeout_sec,
+                    )
+                except (OSError, ConnectionError):
+                    continue
+                if status == 200 and isinstance(parsed, dict):
+                    doc = parsed
+                    break
+            self.scrapes += 1
+            if doc is None:
+                self.scrape_errors += 1
+                continue
+            for sample in doc.get("samples") or []:
+                if not isinstance(sample, dict):
+                    continue
+                wall = sample.get("wall")
+                if not isinstance(wall, (int, float)):
+                    continue
+                data = _relabel_partition(sample.get("data") or {}, name)
+                self.ring.append_flat(float(wall), data)
+                if self.store is not None:
+                    self.store.append_sample(float(wall), data)
+                cursor = max(cursor, float(wall))
+                collected += 1
+            self._cursors[name] = cursor
+        self.samples_collected += collected
+        return collected
+
+    def query(
+        self,
+        name: str,
+        label_filter: Optional[Dict[str, str]] = None,
+        rate: bool = False,
+        since: Optional[float] = None,
+        step: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        out = query_history(
+            name, label_filter=label_filter, rate=rate,
+            since=since, step=step, ring=self.ring, store=self.store,
+        )
+        out["fleet"] = True
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "interval_sec": self.interval_sec,
+            "scrapes": self.scrapes,
+            "scrape_errors": self.scrape_errors,
+            "samples_collected": self.samples_collected,
+            "cursors": {k: round(v, 3) for k, v in self._cursors.items()},
+            "store": self.store.stats() if self.store is not None else None,
+        }
+
+    def start(self) -> "FleetCollector":
+        if self._thread is None:
+            self._stop.clear()
+
+            def loop() -> None:
+                while not self._stop.wait(self.interval_sec):
+                    try:
+                        self.collect_once()
+                    except Exception:  # noqa: BLE001 — a scrape hiccup
+                        # must not kill the collector; next round retries.
+                        self.scrape_errors += 1
+
+            self._thread = threading.Thread(
+                target=loop, name="fleet-collector", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self.store is not None:
+            self.store.close()
+
+
 # ---- the HTTP process ----
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
     core: RouterCore              # set by RouterServer on the built class
     fanout_timeout_sec: float = 5.0
+    collector: Optional[FleetCollector] = None  # set by RouterServer
 
     def log_message(self, *args: Any) -> None:
         pass
@@ -617,6 +778,44 @@ class _RouterHandler(BaseHTTPRequestHandler):
         elif path == "/v1/router":
             self._send(200, core.stats())
         elif path.startswith("/v1/timeseries"):
+            split = urlsplit(path)
+            q = parse_qs(split.query)
+            if (
+                self.collector is not None
+                and split.path == "/v1/timeseries"
+                and ("since" in q or "step" in q)
+            ):
+                # Historical fleet query (ISSUE 20): served from the
+                # router's own collected store, partition-labelled.
+                name = q.get("name", [None])[0]
+                if not name:
+                    self._send(400, {"error": "name is required"})
+                    return
+                try:
+                    since = (
+                        float(q["since"][0]) if "since" in q else None
+                    )
+                    step = float(q["step"][0]) if "step" in q else None
+                except ValueError:
+                    self._send(400, {
+                        "error": "since/step must be numbers"
+                    })
+                    return
+                if since is not None and since <= 1e6:
+                    since = time.time() - max(0.0, since)
+                rate = q.get("rate", ["0"])[0] in ("1", "true", "yes")
+                label_filter = {
+                    k: v[0] for k, v in q.items()
+                    if k not in
+                    ("name", "rate", "window_sec", "since", "step") and v
+                }
+                body = self.collector.query(
+                    name, label_filter or None, rate=rate,
+                    since=since, step=step,
+                )
+                body["enabled"] = True
+                self._send(200, body)
+                return
             results = self._fanout_get(path)
             series: List[Any] = []
             enabled = False
@@ -631,6 +830,23 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 200,
                 {"enabled": enabled, "name": name_field, "series": series},
             )
+        elif path == "/v1/incidents":
+            results = self._fanout_get(path)
+            incidents: List[Any] = []
+            enabled = False
+            for pname, doc in results.items():
+                if not isinstance(doc, dict):
+                    continue
+                enabled = enabled or bool(doc.get("enabled"))
+                for header in doc.get("incidents") or []:
+                    if isinstance(header, dict):
+                        header = dict(header)
+                        header["partition"] = pname
+                        incidents.append(header)
+            incidents.sort(
+                key=lambda h: (h.get("wall") or 0.0), reverse=True
+            )
+            self._send(200, {"enabled": enabled, "incidents": incidents})
         elif path.startswith("/v1/debug/requests"):
             results = self._fanout_get(path)
             merged_reqs: List[Any] = []
@@ -644,6 +860,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
         elif path.startswith((
             "/v1/jobs/", "/v1/infer/", "/v1/trace/", "/v1/traces",
             "/v1/debug/events", "/v1/profile/", "/v1/workflows/",
+            "/v1/incidents/",
         )):
             self._first_found(path)
         else:
@@ -664,6 +881,9 @@ class RouterServer:
         depth_cache_sec: float = 0.25,
         timeout_sec: float = 30.0,
         fanout_timeout_sec: float = 5.0,
+        collect_interval_sec: float = 0.0,
+        fleet_tsdb_dir: str = "",
+        fleet_window_sec: float = 900.0,
     ) -> None:
         def post_fn(url, path, body, _timeout):  # noqa: ANN001
             return http_post_json(url, path, body, timeout_sec)
@@ -679,10 +899,25 @@ class RouterServer:
             depth_cache_sec=depth_cache_sec,
             timeout_sec=timeout_sec,
         )
+        # Fleet telemetry collection (ISSUE 20): >0 scrapes each
+        # partition's export deltas into one partition-labelled store.
+        self.collector: Optional[FleetCollector] = None
+        if collect_interval_sec > 0:
+            self.collector = FleetCollector(
+                pmap,
+                interval_sec=collect_interval_sec,
+                window_sec=fleet_window_sec,
+                tsdb_dir=fleet_tsdb_dir,
+                timeout_sec=fanout_timeout_sec,
+            )
         handler = type(
             "Handler",
             (_RouterHandler,),
-            {"core": self.core, "fanout_timeout_sec": fanout_timeout_sec},
+            {
+                "core": self.core,
+                "fanout_timeout_sec": fanout_timeout_sec,
+                "collector": self.collector,
+            },
         )
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
@@ -701,9 +936,13 @@ class RouterServer:
             target=self._httpd.serve_forever, name="router-http", daemon=True
         )
         self._thread.start()
+        if self.collector is not None:
+            self.collector.start()
         return self
 
     def stop(self) -> None:
+        if self.collector is not None:
+            self.collector.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
@@ -771,6 +1010,7 @@ def main() -> int:
         )
         return 2
 
+    obs = ObsConfig.from_env()
     server = RouterServer(
         pmap,
         host=cfg.router_host,
@@ -778,6 +1018,13 @@ def main() -> int:
         steal=StealPolicy.from_env(),
         depth_cache_sec=cfg.depth_cache_sec,
         timeout_sec=cfg.timeout_sec,
+        # Fleet telemetry collection (ISSUE 20): ROUTER_COLLECT_SEC=0
+        # disables; ROUTER_TSDB_DIR="" keeps the fleet view in-memory.
+        collect_interval_sec=env_float(
+            "ROUTER_COLLECT_SEC", obs.tsdb_interval_sec
+        ),
+        fleet_tsdb_dir=env_str("ROUTER_TSDB_DIR", "").strip(),
+        fleet_window_sec=obs.tsdb_window_sec,
     )
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())

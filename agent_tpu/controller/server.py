@@ -453,7 +453,9 @@ class _Handler(BaseHTTPRequestHandler):
             # Controller trend ring (ISSUE 9): ?name=<family> (required),
             # ?rate=1 for per-second deltas, ?window_sec=N to narrow, and
             # any other query key=value pairs filter series labels
-            # (?op=map_classify_tpu&tenant=a).
+            # (?op=map_classify_tpu&tenant=a). ?since=<epoch>/?step=<sec>
+            # (ISSUE 20) serve history from the durable store; values of
+            # since up to 1e6 are read as "seconds ago".
             name = query.get("name", [None])[0]
             if not name:
                 self._send(400, {
@@ -466,17 +468,51 @@ class _Handler(BaseHTTPRequestHandler):
                     float(query["window_sec"][0])
                     if "window_sec" in query else None
                 )
+                since = (
+                    float(query["since"][0]) if "since" in query else None
+                )
+                step = (
+                    float(query["step"][0]) if "step" in query else None
+                )
             except ValueError:
-                self._send(400, {"error": "window_sec must be a number"})
+                self._send(400, {
+                    "error": "window_sec/since/step must be numbers"
+                })
                 return
+            if since is not None and since <= 1e6:
+                since = time.time() - max(0.0, since)
             rate = query.get("rate", ["0"])[0] in ("1", "true", "yes")
             label_filter = {
                 k: v[0] for k, v in query.items()
-                if k not in ("name", "rate", "window_sec") and v
+                if k not in ("name", "rate", "window_sec", "since", "step")
+                and v
             }
             self._send(200, self.controller.timeseries_json(
                 name, label_filter or None, rate=rate, window_sec=window,
+                since=since, step=step,
             ))
+            return
+        if path == "/v1/timeseries/export":
+            # Delta-scrape surface (ISSUE 20): raw ring samples newer than
+            # ?since=<epoch> — the router collector's cursor endpoint.
+            try:
+                since = float(query.get("since", ["0"])[0])
+            except ValueError:
+                self._send(400, {"error": "since must be a number"})
+                return
+            self._send(200, self.controller.timeseries_export_json(since))
+            return
+        if path == "/v1/incidents":
+            self._send(200, self.controller.incidents_json())
+            return
+        if path.startswith("/v1/incidents/"):
+            incident_id = path[len("/v1/incidents/"):]
+            out = self.controller.incidents_json(incident_id)
+            if out.get("enabled") and out.get("incident") is None:
+                self._send(404, {"error": f"unknown incident "
+                                          f"{incident_id!r}"})
+            else:
+                self._send(200, out)
             return
         if path == "/v1/profile/host":
             # Host sampling profiler (ISSUE 9): collapsed-stack flamegraph

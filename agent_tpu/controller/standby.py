@@ -78,6 +78,11 @@ class HotStandby:
             else JournalConfig()
         self.poll_interval_sec = max(0.005, float(poll_interval_sec))
         self.sweep_interval_sec = sweep_interval_sec
+        # The replica must never append to the primary's tsdb segment
+        # streams while the primary lives — the store opens at promotion
+        # (finalize_promotion), sealing whatever torn tail the dead
+        # primary left (ISSUE 20).
+        controller_kwargs.setdefault("tsdb_defer_open", True)
         self.controller = Controller(
             journal_path=None, journal=self.journal_config,
             **controller_kwargs,

@@ -190,6 +190,7 @@ def build_health(
     agent_stale_sec: float = DEFAULT_AGENT_STALE_SEC,
     now_wall: Optional[float] = None,
     partition: Optional[str] = None,
+    anomalies: Sequence[Mapping[str, Any]] = (),
 ) -> Dict[str, Any]:
     """Assemble the ``GET /v1/health`` body. Pure: every input is data the
     controller already holds (SLO evaluations, job counts, scheduler depth,
@@ -224,6 +225,20 @@ def build_health(
         if state == "page":
             verdict = "page"
         elif verdict == "ok":
+            verdict = "warn"
+    # Confirmed anomaly episodes (ISSUE 20) warn like any other burn
+    # signal — robust-baseline detection feeds the same verdict machinery.
+    for ev in anomalies:
+        reasons.append({
+            "kind": "anomaly",
+            "watch": ev.get("watch"),
+            "value": ev.get("value"),
+            "baseline_median": ev.get("baseline_median"),
+            "z": ev.get("z"),
+            "direction": ev.get("direction"),
+            "wall": ev.get("wall"),
+        })
+        if verdict == "ok":
             verdict = "warn"
     live = [n for n in agent_rows if n not in stale]
     if queue_depth > 0 and agent_rows and not live:

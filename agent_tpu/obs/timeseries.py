@@ -34,13 +34,15 @@ def flatten_snapshot(snap: Mapping[str, Any]) -> Dict[str, Dict[str, float]]:
     ``label_key`` is the canonical JSON of the sorted label pairs (the same
     identity ``merge_snapshots`` uses), so a series keeps its key across
     samples. Histograms contribute ``<name>_sum`` and ``<name>_count``
-    families — their per-bucket shape is the registry's job; the ring only
-    owes rates."""
+    families plus — since the durable tsdb (ISSUE 20) — a
+    ``<name>_bucket`` family with an ``le`` label per slot (``+Inf`` for
+    the overflow), so downsampled aggregates keep quantiles computable."""
     out: Dict[str, Dict[str, float]] = {}
     for name, fam in snap.items():
         if not isinstance(fam, Mapping):
             continue
         kind = fam.get("type")
+        edges = fam.get("buckets")
         for s in fam.get("series", []):
             labels = s.get("labels", {}) or {}
             key = json.dumps(sorted(labels.items()), separators=(",", ":"))
@@ -51,6 +53,16 @@ def flatten_snapshot(snap: Mapping[str, Any]) -> Dict[str, Dict[str, float]]:
                 out.setdefault(f"{name}_count", {})[key] = float(
                     s.get("count", 0)
                 )
+                counts = s.get("counts") or []
+                if edges and counts:
+                    slots = [str(float(e)) for e in edges] + ["+Inf"]
+                    bfam = out.setdefault(f"{name}_bucket", {})
+                    for le, c in zip(slots, counts):
+                        bkey = json.dumps(
+                            sorted(list(labels.items()) + [("le", le)]),
+                            separators=(",", ":"),
+                        )
+                        bfam[bkey] = float(c)
             else:
                 out.setdefault(name, {})[key] = float(s.get("value", 0.0))
     return out
@@ -95,6 +107,12 @@ class TimeSeriesRing:
         self._samples: "collections.deque" = collections.deque(maxlen=maxlen)
         self._last = float("-inf")
         self._lock = threading.Lock()
+        # Persist hook (ISSUE 20): called OUTSIDE the lock with
+        # (wall, mono, data) after every recorded sample — the durable
+        # tsdb and the anomaly detector ride every ring sample. Failures
+        # are swallowed here (the owner keeps its own error counter);
+        # telemetry must never take down the hot path feeding it.
+        self.on_sample: Optional[Callable[[float, float, Dict[str, Dict[str, float]]], None]] = None
 
     def __len__(self) -> int:
         with self._lock:
@@ -139,8 +157,45 @@ class TimeSeriesRing:
                 # win per label key (they never overlap in practice —
                 # controller families are controller_*/sched_* prefixed).
                 data.setdefault(name, {}).update(series)
+        self.append_flat(wall, data, now=now)
+
+    def append_flat(
+        self,
+        wall: float,
+        data: Dict[str, Dict[str, float]],
+        now: Optional[float] = None,
+    ) -> None:
+        """Record one already-flattened sample (the router's collector
+        replays scraped partition samples through this)."""
+        if now is None:
+            now = self._clock()
         with self._lock:
             self._samples.append({"mono": now, "wall": wall, "data": data})
+        hook = self.on_sample
+        if hook is not None:
+            try:
+                hook(wall, now, data)
+            except Exception:  # noqa: BLE001 — see ctor comment
+                pass
+
+    def samples_since(
+        self, wall: float, limit: int = 0
+    ) -> List[Dict[str, Any]]:
+        """Samples strictly NEWER than ``wall``, oldest first — the
+        ``/v1/timeseries/export`` delta-scrape cursor contract."""
+        with self._lock:
+            out = [
+                {"wall": s["wall"], "data": s["data"]}
+                for s in self._samples
+                if s["wall"] > wall
+            ]
+        if limit and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def last_wall(self) -> Optional[float]:
+        with self._lock:
+            return self._samples[-1]["wall"] if self._samples else None
 
     def names(self) -> List[str]:
         seen: Dict[str, None] = {}

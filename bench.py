@@ -2059,6 +2059,102 @@ def _bench_dag_cache() -> dict:
     }
 
 
+def _bench_telemetry() -> dict:
+    """Durable telemetry leg (ISSUE 20): the cost of persisting every
+    sweep sample + scoring it for anomalies, and the forensic snapshot
+    latency. Two numbers for the trend line:
+
+    - ``tsdb_overhead_ratio`` — rows/sec on a pure-controller loopback
+      drain with the on-disk store + detector + bundler enabled, over the
+      same drain with them off (best-of-3 interleaved; ≈1.0 means the
+      durable pipeline rides the sweep for free).
+    - ``incident_capture_ms`` — median wall time of one correlated bundle
+      snapshot (timeseries window + flight recorder + reqlog tail +
+      status + health) on a controller with a warm ring.
+    """
+    import statistics
+    import tempfile as _tempfile
+
+    from agent_tpu.agent.app import Agent as _Agent
+    from agent_tpu.chaos import LoopbackSession
+    from agent_tpu.config import AgentConfig, Config, ObsConfig
+    from agent_tpu.controller.core import Controller
+
+    rows, shard = 65536, 1024
+
+    def run_drain(tmp: str, enabled: bool, i: int) -> float:
+        csv_path = os.path.join(tmp, "rows.csv")
+        if not os.path.exists(csv_path):
+            with open(csv_path, "w", encoding="utf-8") as f:
+                f.write("id,text,risk\n")
+                for r in range(rows):
+                    f.write(f'{r},"record {r}",{(r % 13) * 0.5}\n')
+        obs = ObsConfig(
+            tsdb_dir=os.path.join(tmp, f"tsdb-{i}") if enabled else "",
+            tsdb_interval_sec=0.1,
+            anomaly_enabled=enabled, incident_enabled=enabled,
+        )
+        controller = Controller(journal_path=None, obs=obs)
+        controller.submit_csv_job(
+            csv_path, total_rows=rows, shard_size=shard,
+            map_op="risk_accumulate", extra_payload={"field": "risk"},
+        )
+        cfg = Config(agent=AgentConfig(
+            controller_url="http://loopback", agent_name=f"tel-{i}",
+            tasks=("risk_accumulate",), max_tasks=4, idle_sleep_sec=0.0,
+            error_backoff_sec=0.0,
+        ))
+        agent = _Agent(config=cfg, session=LoopbackSession(controller))
+        agent._profile = {"tier": "bench"}
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + 120
+        while not controller.drained() and time.monotonic() < deadline:
+            leased = agent.lease_once()
+            if leased is None:
+                controller.sweep()
+                continue
+            lease_id, tasks = leased
+            for task in tasks:
+                agent.run_task(lease_id, task)
+        dt = time.perf_counter() - t0
+        assert controller.drained(), controller.counts()
+        controller.close()
+        return rows / dt
+
+    with _tempfile.TemporaryDirectory(prefix="bench_telemetry_") as tmp:
+        best_on = best_off = 0.0
+        for i in range(3):
+            best_off = max(best_off, run_drain(tmp, False, i))
+            best_on = max(best_on, run_drain(tmp, True, i))
+
+        # Capture latency on a warm controller: populated ring + recorder.
+        obs = ObsConfig(
+            tsdb_dir=os.path.join(tmp, "tsdb-cap"),
+            tsdb_interval_sec=0.0,
+            incident_dir=os.path.join(tmp, "inc-cap"),
+            incident_min_interval_sec=0.0,
+        )
+        controller = Controller(journal_path=None, obs=obs)
+        for i in range(8):
+            controller.submit("echo", {"i": i})
+            controller.sweep()
+        capture_ms = []
+        for i in range(7):
+            t0 = time.perf_counter()
+            controller._capture_incident(
+                "anomaly", f"bench-{i}", {"watch": "bench", "z": 10.0}
+            )
+            capture_ms.append((time.perf_counter() - t0) * 1e3)
+        controller.close()
+
+    return {
+        "rows_per_sec_off": round(best_off, 1),
+        "rows_per_sec_on": round(best_on, 1),
+        "overhead_ratio": round(best_on / best_off, 4) if best_off else None,
+        "incident_capture_ms": round(statistics.median(capture_ms), 3),
+    }
+
+
 def main() -> int:
     from agent_tpu.runtime.runtime import get_runtime
 
@@ -2153,6 +2249,13 @@ def main() -> int:
     except Exception as exc:  # noqa: BLE001 — an AssertionError here is
         # the cache failing its own acceptance bar; it must surface.
         legs["dag_cache"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+
+    # Durable telemetry (ISSUE 20): sweep-sample persistence overhead on a
+    # pure-controller drain + the incident snapshot latency.
+    try:
+        legs["telemetry"] = _bench_telemetry()
+    except Exception as exc:  # noqa: BLE001
+        legs["telemetry"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
     try:
         classify_drain, mixed_drain = _bench_drain(runtime)
@@ -2390,6 +2493,13 @@ def main() -> int:
                 "cache_hit_rate": legs["dag_cache"].get("hit_rate"),
                 "cache_effective_speedup": legs["dag_cache"]
                 .get("effective_speedup"),
+                # Durable telemetry flat fields (ISSUE 20): the throughput
+                # cost of persisting+scoring every sweep sample (≈1.0 =
+                # free) and the forensic bundle snapshot latency.
+                "tsdb_overhead_ratio": legs["telemetry"]
+                .get("overhead_ratio"),
+                "incident_capture_ms": legs["telemetry"]
+                .get("incident_capture_ms"),
             }
         ),
         flush=True,

@@ -40,7 +40,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from agent_tpu.agent.app import Agent
 from agent_tpu.chaos import ChaosSession, FaultPlan, GatedSession, LoopbackSession
-from agent_tpu.config import AgentConfig, Config, SchedConfig
+from agent_tpu.config import AgentConfig, Config, ObsConfig, SchedConfig
 from agent_tpu.controller.core import TERMINAL_STATES, Controller
 from agent_tpu.obs.metrics import MetricsRegistry
 
@@ -384,6 +384,156 @@ def run_chaos(
     return problems
 
 
+def run_anomaly_drill(
+    seed: int, deadline_sec: float, calm: bool = False,
+) -> List[str]:
+    """ISSUE 20: the forensics drill. A calm trickle warms the detector's
+    baseline, then a delay-fault burst stalls the agent while submissions
+    continue — queue depth spikes far past the robust baseline and the
+    detector must confirm exactly ONE anomaly episode, which must snapshot
+    exactly ONE incident bundle. With ``calm=True`` the burst never
+    happens and the same drive must produce ZERO anomalies and ZERO
+    bundles (the false-positive gate)."""
+    problems: List[str] = []
+    label = "calm" if calm else "burst"
+    with tempfile.TemporaryDirectory(prefix=f"anomaly_{label}_") as tmp:
+        obs = ObsConfig(
+            tsdb_dir=os.path.join(tmp, "tsdb"), tsdb_interval_sec=0.03,
+            anomaly_window=60, anomaly_warmup=10, anomaly_z=8.0,
+            anomaly_confirm=2, anomaly_clear=5,
+            incident_dir=os.path.join(tmp, "incidents"),
+            incident_min_interval_sec=60.0,
+        )
+        controller = Controller(
+            lease_ttl_sec=5.0, max_attempts=5, requeue_delay_sec=0.01,
+            obs=obs,
+        )
+        plan = FaultPlan(seed=seed)  # delay flipped live for the burst
+        agent = make_agent(controller, f"anom-{seed}", plan=plan)
+        submitted = 0
+
+        def pump(n: int) -> None:
+            nonlocal submitted
+            for _ in range(n):
+                controller.submit(
+                    "risk_accumulate",
+                    {"values": [float(submitted % 5), 1.0]},
+                    job_id=f"anom-{label}-{seed}-{submitted}",
+                )
+                submitted += 1
+
+        def drive(until: float, per_tick: int) -> None:
+            while time.monotonic() < until:
+                pump(per_tick)
+                agent.flush_spool()
+                try:
+                    leased = agent.lease_once()
+                except RuntimeError:
+                    leased = None
+                if leased is not None:
+                    lease_id, tasks = leased
+                    for task in tasks:
+                        agent.run_task(lease_id, task)
+                controller.sweep()  # interval-gated TSDB sample rides here
+                time.sleep(0.005)
+
+        try:
+            # Calm warmup: the trickle drains as fast as it arrives, so
+            # the baseline learns a near-zero queue.
+            drive(time.monotonic() + 1.5, per_tick=1)
+            if not calm:
+                # The burst: every transport request now sleeps, the agent
+                # stalls, and submissions keep landing.
+                plan.delay = 1.0
+                plan.delay_max_sec = 0.12
+                drive(time.monotonic() + 1.2, per_tick=4)
+                plan.delay = 0.0
+            # Recovery drain: everything terminal, detector sees the
+            # episode clear.
+            deadline = time.monotonic() + deadline_sec
+            while not controller.drained() and time.monotonic() < deadline:
+                agent.flush_spool()
+                try:
+                    leased = agent.lease_once()
+                except RuntimeError:
+                    leased = None
+                if leased is not None:
+                    lease_id, tasks = leased
+                    for task in tasks:
+                        agent.run_task(lease_id, task)
+                controller.sweep()
+            agent.flush_spool(force=True)
+            drained = controller.drained()
+
+            astats = controller.anomaly.stats() \
+                if controller.anomaly is not None else {}
+            bundles = controller.incidents.list() \
+                if controller.incidents is not None else []
+            anomaly_bundles = [b for b in bundles if b["kind"] == "anomaly"]
+            if not drained:
+                problems.append(
+                    f"anomaly drill ({label}, seed {seed}): drain did not "
+                    f"complete (counts {controller.counts()})"
+                )
+            if calm:
+                if astats.get("events_total", 0) != 0:
+                    problems.append(
+                        f"anomaly drill (calm, seed {seed}): false "
+                        f"positive — detector confirmed {astats}"
+                    )
+                if anomaly_bundles:
+                    problems.append(
+                        f"anomaly drill (calm, seed {seed}): "
+                        f"{len(anomaly_bundles)} unexpected incident "
+                        "bundle(s)"
+                    )
+            else:
+                if plan.counts.get("delay", 0) == 0:
+                    problems.append(
+                        f"anomaly drill (burst, seed {seed}): no delay "
+                        "faults injected — drill vacuous"
+                    )
+                if astats.get("events_total", 0) != 1:
+                    problems.append(
+                        f"anomaly drill (burst, seed {seed}): expected "
+                        f"exactly 1 confirmed episode, got {astats}"
+                    )
+                if len(anomaly_bundles) != 1:
+                    problems.append(
+                        f"anomaly drill (burst, seed {seed}): expected "
+                        f"exactly 1 incident bundle, got "
+                        f"{[b['id'] for b in anomaly_bundles]}"
+                    )
+                elif anomaly_bundles[0]["key"] != "queue_depth":
+                    problems.append(
+                        f"anomaly drill (burst, seed {seed}): bundle "
+                        f"watched {anomaly_bundles[0]['key']!r}, expected "
+                        "queue_depth"
+                    )
+                else:
+                    # The bundle is a real forensic: correlated sections
+                    # present and the full body fetchable by id.
+                    body = controller.incidents.get(anomaly_bundles[0]["id"])
+                    for section in ("timeseries", "status", "health"):
+                        if section not in (body or {}).get("sections", {}):
+                            problems.append(
+                                f"anomaly drill (burst, seed {seed}): "
+                                f"bundle missing section {section!r}"
+                            )
+            if not calm:
+                print(json.dumps({
+                    "scenario": "anomaly_drill", "seed": seed,
+                    "submitted": submitted,
+                    "delays_injected": plan.counts.get("delay", 0),
+                    "detector": astats,
+                    "incidents": [b["id"] for b in anomaly_bundles],
+                    "ok": not problems,
+                }, sort_keys=True))
+        finally:
+            controller.close()
+    return problems
+
+
 def run_fair(
     seed: int, csv_path: str, shards: int, rows_per_shard: int,
     fault_rate: float, n_agents: int, tenants: int, deadline_sec: float,
@@ -662,6 +812,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # way.
                     problems += run_outage(
                         seed, csv_path, shards, rows, deadline
+                    )
+            # ISSUE 20 forensics drill: one latency burst must confirm
+            # exactly one anomaly + one incident bundle, and calm seeded
+            # drives must confirm NONE (the false-positive gate).
+            if args.policy == "fifo":
+                problems += run_anomaly_drill(seeds[0], deadline)
+                for calm_seed in range(seeds[0] + 100, seeds[0] + 105):
+                    problems += run_anomaly_drill(
+                        calm_seed, deadline, calm=True
                     )
 
     elapsed = round(time.monotonic() - t0, 3)

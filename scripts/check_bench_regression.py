@@ -54,6 +54,9 @@ LOWER_BETTER = {
     "serving_ttft_p50_ms",
     "serving_ttft_p99_ms",
     "serving_disagg_ttft_p99_ms",
+    # Incident forensics (ISSUE 20): the bundle snapshot runs inline on
+    # the sample path when an anomaly confirms — latency is the number.
+    "incident_capture_ms",
 }
 
 # Fields that are identity/config, not performance — never judged.
@@ -100,6 +103,11 @@ PER_FIELD_TOLERANCE = {
     # keeps the default band.
     "dag_rows_per_sec": 0.25,
     "cache_effective_speedup": 0.25,
+    # Durable telemetry (ISSUE 20): the overhead ratio divides two drain
+    # rates (noise compounds); the capture latency is a sub-ms median on
+    # a shared runner.
+    "tsdb_overhead_ratio": 0.15,
+    "incident_capture_ms": 0.50,
 }
 
 

@@ -74,7 +74,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from agent_tpu.agent.app import Agent
 from agent_tpu.chaos import FaultPlan, LoopbackSession
-from agent_tpu.config import AgentConfig, Config, JournalConfig
+from agent_tpu.config import AgentConfig, Config, JournalConfig, ObsConfig
 from agent_tpu.controller.core import Controller
 from agent_tpu.controller.journal import list_segments, load_snapshot
 from agent_tpu.controller.server import ControllerServer
@@ -353,6 +353,12 @@ def run_failover(
         MAX_ATTEMPTS="10",
         REQUEUE_DELAY_SEC="0.01",
         CONTROLLER_SWEEP_SEC="0.2",
+        # Durable telemetry (ISSUE 20): the primary persists samples here
+        # and the promoted standby reopens the same store — pre-kill
+        # history must stay queryable after the flip.
+        TSDB_DIR=os.path.join(tmp, "tsdb"),
+        TSDB_INTERVAL="0.2",
+        INCIDENT_DIR=os.path.join(tmp, "incidents"),
     )
     primary = subprocess.Popen(
         [sys.executable, "-m", "agent_tpu.controller.server"],
@@ -366,6 +372,7 @@ def run_failover(
     threads: List[threading.Thread] = []
     kills = 0
     succeeded_at_kill = 0
+    prekill_walls: List[float] = []
     try:
         if not wait_for_status(url_a, 20.0):
             problems.append(f"seed {seed}: primary never became healthy")
@@ -374,6 +381,12 @@ def run_failover(
             journal_path, journal=JOURNAL_CFG, poll_interval_sec=0.02,
             sweep_interval_sec=0.2, lease_ttl_sec=3.0, max_attempts=10,
             requeue_delay_sec=0.01,
+            # Same durable store the primary writes; the replica defers
+            # opening it (HotStandby sets tsdb_defer_open) until promotion.
+            obs=ObsConfig(
+                tsdb_dir=env["TSDB_DIR"], tsdb_interval_sec=0.2,
+                incident_dir=env["INCIDENT_DIR"],
+            ),
         ).start()
 
         agents = [
@@ -419,6 +432,21 @@ def run_failover(
                 or shards_done >= max(kill_floor + 1, int(shards * 0.6))
             )
             if armed and (plan.decide("controller_kill") or forced):
+                # Snapshot the primary's durable history moments before
+                # the kill: these exact samples must still be queryable
+                # from the promoted standby (same TSDB_DIR).
+                try:
+                    _, ts_body = http_json(
+                        url_a + "/v1/timeseries"
+                        "?name=controller_queue_depth&since=600",
+                        timeout=2,
+                    )
+                    for s in (ts_body or {}).get("series", []):
+                        prekill_walls.extend(
+                            w for w, _v in s.get("points", [])
+                        )
+                except Exception:  # noqa: BLE001 — capture best-effort
+                    pass
                 primary.send_signal(signal.SIGKILL)
                 primary.wait(timeout=10)
                 kills += 1
@@ -558,6 +586,37 @@ def run_failover(
                 problems.append(
                     f"seed {seed}: journal status block missing {key!r}"
                 )
+
+        # ---- durable telemetry survives the flip (ISSUE 20): samples the
+        # dead primary persisted are queryable from the promoted standby
+        # over real HTTP, out of the reopened on-disk store. ----
+        if prekill_walls:
+            status, ts_body = http_json(
+                url_b + "/v1/timeseries"
+                "?name=controller_queue_depth&since=3600",
+                timeout=3,
+            )
+            post_walls = set()
+            for s in (ts_body or {}).get("series", []):
+                post_walls.update(w for w, _v in s.get("points", []))
+            missing = [w for w in prekill_walls if w not in post_walls]
+            if status != 200 or (ts_body or {}).get("source") != "tsdb":
+                problems.append(
+                    f"seed {seed}: promoted /v1/timeseries history not "
+                    f"served from the durable store: HTTP {status} "
+                    f"source={(ts_body or {}).get('source')!r}"
+                )
+            elif missing:
+                problems.append(
+                    f"seed {seed}: {len(missing)}/{len(prekill_walls)} "
+                    "pre-kill telemetry samples lost across promotion "
+                    f"(e.g. wall {missing[0]})"
+                )
+        else:
+            problems.append(
+                f"seed {seed}: no pre-kill telemetry captured — the "
+                "primary's TSDB never produced samples before the kill"
+            )
 
         # ---- retire the fleet through the drain path ----
         for a in agents:

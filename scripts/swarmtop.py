@@ -300,11 +300,31 @@ def spark(values, width: int = 12) -> str:
     )
 
 
-def fetch_series(base: str, name: str, rate: bool = False, **labels):
+def parse_since(text):
+    """``10m`` / ``2h`` / ``600`` → seconds-ago (float), or None."""
+    if not text:
+        return None
+    text = text.strip().lower()
+    mult = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}.get(text[-1])
+    try:
+        return float(text[:-1]) * mult if mult else float(text)
+    except ValueError:
+        return None
+
+
+def fetch_series(base: str, name: str, rate: bool = False, since=None,
+                 **labels):
     """``GET /v1/timeseries`` → summed-across-series ``[(ts, value), ...]``
     (label sets collapse — swarmtop trends the fleet line), or None when
-    the endpoint is absent/disabled (pre-ring controller)."""
+    the endpoint is absent/disabled (pre-ring controller). ``since``
+    (seconds-ago) reads durable history through the ISSUE 20 store —
+    windows past the ring get a downsampling ``step`` so the payload
+    stays bounded."""
     q = f"name={name}" + ("&rate=1" if rate else "")
+    if since is not None:
+        q += f"&since={since:g}"
+        if since > 1800:
+            q += f"&step={60 if since <= 43200 else 600}"
     for k, v in labels.items():
         q += f"&{k}={v}"
     body = fetch_json(base + "/v1/timeseries?" + q)
@@ -317,23 +337,37 @@ def fetch_series(base: str, name: str, rate: bool = False, **labels):
     return sorted(acc.items())
 
 
-def collect_trends(base: str):
+def collect_trends(base: str, since=None):
     """The sparkline feed: tasks/s + rows/s rates, queue depth and duty
     cycle levels. Each value is ``[(ts, v), ...]`` or None when the ring
-    doesn't carry the family (yet)."""
+    doesn't carry the family (yet). ``since`` widens every trend to
+    durable history (``--since 10m``)."""
     return {
-        "tasks_per_sec": fetch_series(base, "tasks_total", rate=True),
-        "rows_per_sec": fetch_series(base, "usage_rows_total", rate=True),
+        "tasks_per_sec": fetch_series(
+            base, "tasks_total", rate=True, since=since),
+        "rows_per_sec": fetch_series(
+            base, "usage_rows_total", rate=True, since=since),
         "queue_depth": fetch_series(
-            base, "controller_queue_depth", state="leasable"
+            base, "controller_queue_depth", state="leasable", since=since
         ),
-        "duty_cycle": fetch_series(base, "device_duty_cycle"),
+        "duty_cycle": fetch_series(base, "device_duty_cycle", since=since),
         # Serving (ISSUE 15): emitted tokens/sec off the controller's
         # completion fan-out counter.
         "serve_tok_per_sec": fetch_series(
-            base, "serve_tokens_total", rate=True
+            base, "serve_tokens_total", rate=True, since=since
         ),
     }
+
+
+def incident_summary(base: str):
+    """``GET /v1/incidents`` → the Incidents line feed: total count plus
+    the newest few bundle headers; None when the endpoint is absent
+    (pre-ISSUE-20 controller) or forensics are disabled."""
+    body = fetch_json(base + "/v1/incidents")
+    if not isinstance(body, dict) or not body.get("enabled", False):
+        return None
+    rows = body.get("incidents") or []
+    return {"count": len(rows), "newest": rows[:3]}
 
 
 def last_value(points):
@@ -342,7 +376,7 @@ def last_value(points):
 
 def render(health, status, rate, colors: Colors, trends=None,
            serving=None, req_tail=None, partitions=None,
-           workflows=None) -> str:
+           workflows=None, incidents=None) -> str:
     lines = []
     verdict = health.get("verdict", "?")
     now = time.strftime("%H:%M:%S")
@@ -356,6 +390,17 @@ def render(health, status, rate, colors: Colors, trends=None,
     lines.append(head)
     for r in reasons:
         lines.append(colors.paint(f"  ! {json.dumps(r)}", FG["warn"]))
+    if incidents is not None:
+        newest = ", ".join(
+            f"{h.get('id')} {h.get('kind')}/{h.get('key')} "
+            f"({max(0, time.time() - (h.get('wall') or 0)):.0f}s ago)"
+            for h in incidents.get("newest", [])
+        ) or "none"
+        line = f"incidents: {incidents.get('count', 0)}   {newest}"
+        lines.append(
+            colors.paint("  " + line,
+                         FG["warn"] if incidents.get("count") else DIM)
+        )
     lines.append("")
 
     slo = health.get("slo", {})
@@ -640,8 +685,16 @@ def main() -> int:
                          "(health + status + usage + trend series) and "
                          "exit — the scripting mode")
     ap.add_argument("--no-color", action="store_true")
+    ap.add_argument("--since", default="",
+                    help="trend window from durable history, e.g. 10m / "
+                         "2h / 600 (seconds) — reads the on-disk TSDB "
+                         "through ?since=/?step= instead of the live ring")
     args = ap.parse_args()
     base = args.url.rstrip("/")
+    since = parse_since(args.since)
+    if args.since and since is None:
+        print(f"swarmtop: bad --since {args.since!r}", file=sys.stderr)
+        return 2
     colors = Colors(
         enabled=not args.no_color and not args.json
         and (sys.stdout.isatty() or os.environ.get("FORCE_COLOR"))
@@ -659,12 +712,13 @@ def main() -> int:
             time.sleep(args.interval)
             continue
         status = fetch_json(base + "/v1/status")
-        trends = collect_trends(base)
+        trends = collect_trends(base, since=since)
         metrics_text = fetch_text(base + "/v1/metrics")
         serving = serving_summary(metrics_text, status)
         req_tail = request_tail(base) if serving is not None else None
         partitions = partition_rows(status, health)
         workflows = workflow_rows(base)
+        incidents = incident_summary(base)
         if args.json:
             # One-shot scripting mode (ISSUE 9 satellite): everything the
             # dashboard renders, as one JSON doc on stdout.
@@ -679,6 +733,7 @@ def main() -> int:
                 "request_tail": req_tail,
                 "partitions": partitions,
                 "workflows": workflows,
+                "incidents": incidents,
                 "rates": {
                     "tasks_per_sec": last_value(trends["tasks_per_sec"]),
                     "rows_per_sec": last_value(trends["rows_per_sec"]),
@@ -701,7 +756,8 @@ def main() -> int:
             prev_tasks, prev_t = total, now
         frame = render(health, status, rate, colors, trends=trends,
                        serving=serving, req_tail=req_tail,
-                       partitions=partitions, workflows=workflows)
+                       partitions=partitions, workflows=workflows,
+                       incidents=incidents)
         if args.once:
             sys.stdout.write(frame)
             return 0

@@ -1,0 +1,233 @@
+"""Incident forensics + anomaly detection (ISSUE 20 tentpole c): bundle
+schema, content-addressed ids, dedup/rate-limit, size bounding, disk
+reindex after restart, detector determinism, and the disable knobs."""
+
+import json
+import os
+import time
+
+import pytest
+
+from agent_tpu.config import ObsConfig
+from agent_tpu.obs.anomaly import (
+    AnomalyDetector,
+    counter_rate,
+    default_watches,
+    gauge_sum,
+)
+from agent_tpu.obs.incident import IncidentBundler
+
+KEY = '[["queue","leasable"]]'
+
+
+def qsample(wall, depth):
+    return {"wall": wall,
+            "data": {"controller_queue_depth": {KEY: float(depth)}}}
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---- bundler ----
+
+def test_capture_schema_and_content_address(tmp_path):
+    b = IncidentBundler(directory=str(tmp_path))
+    out = b.capture("anomaly", "queue_depth", {"z": 12.0},
+                    {"timeseries": {"a": 1}, "health": {"verdict": "warn"}})
+    assert out["id"].startswith("inc-")
+    body = b.get(out["id"])
+    for field in ("id", "wall", "kind", "key", "reason", "sections"):
+        assert field in body
+    assert body["sections"]["health"]["verdict"] == "warn"
+    # Content-addressed: the id is derived from the bundle body, so the
+    # on-disk file round-trips to the same id.
+    path = os.path.join(str(tmp_path), out["id"] + ".json")
+    with open(path, encoding="utf-8") as f:
+        assert json.load(f)["id"] == out["id"]
+
+
+def test_dedup_rate_limit():
+    clk = Clock()
+    b = IncidentBundler(min_interval_sec=60.0, clock=clk)
+    first = b.capture("anomaly", "queue_depth", {"z": 9}, {"s": 1})
+    assert first is not None
+    assert b.capture("anomaly", "queue_depth", {"z": 10}, {"s": 2}) is None
+    # A different key is its own incident stream.
+    assert b.capture("anomaly", "ttft_p99", {"z": 9}, {"s": 3}) is not None
+    assert b.stats()["suppressed"] == 1
+    # Past the interval the same key captures again.
+    clk.t += 61.0
+    assert b.capture("anomaly", "queue_depth", {"z": 11}, {"s": 4}) is not None
+
+
+def test_capacity_evicts_oldest():
+    clk = Clock()
+    b = IncidentBundler(capacity=3, min_interval_sec=0.0, clock=clk)
+    ids = []
+    for i in range(5):
+        clk.t += 1.0
+        ids.append(b.capture("slo_page", f"obj{i}", {}, {"s": i})["id"])
+    listed = [h["id"] for h in b.list()]
+    assert len(listed) == 3
+    assert ids[0] not in listed and ids[-1] in listed
+
+
+def test_size_bound_drops_largest_section():
+    b = IncidentBundler(max_bundle_bytes=2048)
+    big = {"rows": ["x" * 100 for _ in range(200)]}
+    out = b.capture("anomaly", "queue_depth", {"z": 9},
+                    {"huge": big, "small": {"ok": True}})
+    body = b.get(out["id"])
+    assert "huge" not in body["sections"]
+    assert body["sections"]["small"] == {"ok": True}
+    assert "huge" in body["truncated_sections"]
+    assert len(json.dumps(body)) <= 2048 + 256
+
+
+def test_disk_reindex_after_restart(tmp_path):
+    b = IncidentBundler(directory=str(tmp_path))
+    out = b.capture("slo_page", "interactive", {"burn": 15.0}, {"s": 1})
+    b2 = IncidentBundler(directory=str(tmp_path))
+    headers = b2.list()
+    assert [h["id"] for h in headers] == [out["id"]]
+    assert b2.get(out["id"])["sections"] == {"s": 1}
+    assert b2.get("inc-nope") is None
+
+
+# ---- detector ----
+
+def test_detector_warmup_gates():
+    det = AnomalyDetector(warmup=10, confirm=2)
+    prev = None
+    events = []
+    for i in range(5):
+        s = qsample(float(i), 500.0)  # wild values, but under warmup
+        events += det.observe(prev, s)
+        prev = s
+    assert events == []
+
+
+def test_detector_confirms_exactly_one_episode():
+    det = AnomalyDetector(warmup=8, confirm=2, clear=3, z_thresh=8.0)
+    prev = None
+    events = []
+    for i in range(30):
+        s = qsample(float(i), 2.0 + (i % 3))
+        events += det.observe(prev, s)
+        prev = s
+    assert events == []
+    for i in range(30, 36):
+        s = qsample(float(i), 90.0)
+        events += det.observe(prev, s)
+        prev = s
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["watch"] == "queue_depth" and ev["direction"] == "high"
+    assert ev["z"] >= 8.0
+    assert det.active()
+    # Recovery clears the episode; a later burst is a NEW event.
+    for i in range(36, 44):
+        s = qsample(float(i), 2.0)
+        events += det.observe(prev, s)
+        prev = s
+    assert not det.active()
+    for i in range(44, 48):
+        s = qsample(float(i), 90.0)
+        events += det.observe(prev, s)
+        prev = s
+    assert len(events) == 2
+
+
+def test_detector_deterministic():
+    def run():
+        det = AnomalyDetector(warmup=8, confirm=2)
+        prev, out = None, []
+        for i in range(40):
+            depth = 3.0 if i < 30 else 120.0
+            s = qsample(float(i), depth)
+            out += det.observe(prev, s)
+            prev = s
+        return out
+
+    assert run() == run()
+
+
+def test_detector_min_delta_suppresses_tiny_shifts():
+    # A flat-line baseline has MAD 0 — without the min_delta floor a +1
+    # wiggle would z-score to infinity. queue_depth requires |delta|>=10.
+    det = AnomalyDetector(warmup=8, confirm=2)
+    prev, events = None, []
+    for i in range(30):
+        s = qsample(float(i), 2.0)
+        events += det.observe(prev, s)
+        prev = s
+    for i in range(30, 36):
+        s = qsample(float(i), 5.0)
+        events += det.observe(prev, s)
+        prev = s
+    assert events == []
+
+
+def test_counter_rate_extractor():
+    key = '[["kind","lease"]]'
+    prev = {"wall": 100.0,
+            "data": {"result_post_failures_total": {key: 10.0}}}
+    cur = {"wall": 110.0,
+           "data": {"result_post_failures_total": {key: 25.0}}}
+    watches = {w.name: w for w in default_watches()}
+    assert watches["lease_error_rate"].extract(prev, cur) == pytest.approx(1.5)
+    # Counter reset clamps to zero, never a negative rate.
+    reset = {"wall": 120.0,
+             "data": {"result_post_failures_total": {key: 3.0}}}
+    assert watches["lease_error_rate"].extract(cur, reset) == 0.0
+
+
+def test_gauge_sum_extractor_missing_family():
+    assert gauge_sum("nope")(None, {"wall": 1.0, "data": {}}) is None
+
+
+# ---- controller knobs ----
+
+def test_disable_knobs(tmp_path):
+    from agent_tpu.controller.core import Controller
+
+    c = Controller(journal_path=None, obs=ObsConfig(
+        anomaly_enabled=False, incident_enabled=False,
+        tsdb_dir=str(tmp_path),
+    ))
+    try:
+        assert c.anomaly is None
+        assert c.incidents is None
+        out = c.incidents_json()
+        assert out["enabled"] is False and out["incidents"] == []
+        c.sweep()  # sampling still persists without the detector
+        assert c.tsdb_store is not None
+    finally:
+        c.close()
+
+
+def test_slo_page_captures_incident(tmp_path):
+    """The SLO page path snapshots a bundle through the same bundler the
+    anomaly path uses — one forensic pipeline for both triggers."""
+    from agent_tpu.controller.core import Controller
+
+    c = Controller(journal_path=None, obs=ObsConfig(
+        incident_dir=str(tmp_path), tsdb_dir="",
+    ))
+    try:
+        c._capture_incident("slo_page", "interactive",
+                            {"objective": "interactive", "burn_short": 20.0})
+        out = c.incidents_json()
+        assert out["enabled"] and len(out["incidents"]) == 1
+        head = out["incidents"][0]
+        assert head["kind"] == "slo_page" and head["key"] == "interactive"
+        body = c.incidents_json(head["id"])["incident"]
+        for section in ("timeseries", "flight_recorder", "status", "health"):
+            assert section in body["sections"], section
+    finally:
+        c.close()

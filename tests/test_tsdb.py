@@ -1,0 +1,286 @@
+"""Durable telemetry store (ISSUE 20 tentpole a): segment rotation,
+tiered downsampling, retention, torn-tail tolerance, restart reopen, and
+the ``?since=``/``?step=`` query path over real HTTP."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from agent_tpu.config import ObsConfig
+from agent_tpu.controller.journal import list_segments
+from agent_tpu.obs.timeseries import TimeSeriesRing
+from agent_tpu.obs.tsdb import (
+    TsdbStore,
+    quantile_from_bucket_series,
+    query_history,
+)
+
+KEY = '[["op","x"]]'
+
+
+def fill(store, t0, n, cadence=10.0, fams=("c_total",)):
+    for i in range(n):
+        store.append_sample(
+            t0 + i * cadence,
+            {fam: {KEY: float(i)} for fam in fams},
+        )
+    store.flush()
+
+
+def test_raw_samples_round_trip(tmp_path):
+    st = TsdbStore(str(tmp_path))
+    t0 = 1_700_000_000.0
+    fill(st, t0, 50)
+    q = st.query("c_total", since=t0)
+    assert len(q["series"]) == 1
+    pts = q["series"][0]["points"]
+    assert len(pts) == 50
+    assert pts[0] == [t0, 0.0]
+    assert pts[-1] == [t0 + 490.0, 49.0]
+    assert q["source"] == "tsdb"
+    st.close()
+
+
+def test_since_until_window(tmp_path):
+    st = TsdbStore(str(tmp_path))
+    t0 = 1_700_000_000.0
+    fill(st, t0, 50)
+    q = st.query("c_total", since=t0 + 100, until=t0 + 200)
+    pts = q["series"][0]["points"]
+    assert all(t0 + 100 <= t <= t0 + 200 for t, _ in pts)
+    assert len(pts) == 11
+    st.close()
+
+
+def test_segment_rotation(tmp_path):
+    st = TsdbStore(str(tmp_path), segment_max_bytes=512)
+    fill(st, 1_700_000_000.0, 100)
+    segs = list_segments(os.path.join(str(tmp_path), "tsdb"))
+    assert len(segs) > 1
+    # Every sample still readable across the rotated files.
+    assert len(st.query("c_total", since=0)["series"][0]["points"]) == 100
+    st.close()
+
+
+def test_downsample_preserves_rates(tmp_path):
+    """The 1m tier must reproduce the raw counter rate exactly on full
+    buckets: sum/count/min/max/last aggregation loses nothing a rate
+    needs (edge buckets are partial by construction — excluded)."""
+    st = TsdbStore(str(tmp_path))
+    t0 = 1_700_000_000.0
+    fill(st, t0, 200)  # +1 per 10s => 0.1/s
+    q = st.query("c_total", since=t0, step=60, rate=True)
+    rates = [v for _, v in q["series"][0]["points"]][1:-1]
+    assert rates
+    assert all(abs(r - 0.1) < 1e-6 for r in rates)
+    assert q["step"] == 60
+
+
+def test_step_selects_tier(tmp_path):
+    st = TsdbStore(str(tmp_path))
+    t0 = 1_700_000_000.0
+    fill(st, t0, 400, cadence=10.0)
+    assert st.query("c_total", since=t0, step=60)["step"] == 60
+    assert st.query("c_total", since=t0, step=600)["step"] == 600
+    assert not st.query("c_total", since=t0)["step"]  # raw tier
+
+
+def test_agg_points_carry_min_max(tmp_path):
+    st = TsdbStore(str(tmp_path))
+    t0 = 1_700_000_000.0
+    for i in range(120):
+        st.append_sample(t0 + i * 5, {"g": {KEY: float(i % 10)}})
+    st.flush()
+    q = st.query("g", since=t0, step=60)
+    aggs = q["series"][0]["agg_points"]
+    assert aggs
+    # Interior buckets saw the full 0..9 sawtooth.
+    for _t1, _s, n, mn, mx in aggs[1:-1]:
+        assert mn == 0.0 and mx == 9.0 and n == 12
+
+
+def test_torn_tail_skipped_on_reopen(tmp_path):
+    st = TsdbStore(str(tmp_path))
+    t0 = 1_700_000_000.0
+    fill(st, t0, 30)
+    st.close()
+    segs = list_segments(os.path.join(str(tmp_path), "tsdb"))
+    with open(segs[-1][1], "a", encoding="utf-8") as f:
+        f.write('{"ev":"s","wall":170')  # the mid-append crash
+    st2 = TsdbStore(str(tmp_path))
+    assert len(st2.query("c_total", since=0)["series"][0]["points"]) == 30
+    st2.append_sample(t0 + 900, {"c_total": {KEY: 30.0}})
+    st2.flush()
+    assert len(st2.query("c_total", since=0)["series"][0]["points"]) == 31
+    st2.close()
+
+
+def test_restart_reopens_history(tmp_path):
+    t0 = 1_700_000_000.0
+    st = TsdbStore(str(tmp_path))
+    fill(st, t0, 40)
+    st.close()
+    st2 = TsdbStore(str(tmp_path))
+    assert len(st2.query("c_total", since=0)["series"][0]["points"]) == 40
+    st2.close()
+
+
+def test_byte_retention_drops_oldest_raw_first(tmp_path):
+    st = TsdbStore(str(tmp_path), segment_max_bytes=512, max_bytes=2048)
+    t0 = 1_700_000_000.0
+    fill(st, t0, 400)
+    removed = st.gc(now=t0 + 5000)
+    assert removed > 0
+    total = 0
+    for base in ("tsdb", "tsdb-60", "tsdb-600"):
+        for _seq, path in list_segments(os.path.join(str(tmp_path), base)):
+            total += os.path.getsize(path)
+    # The cap, plus one never-evicted active segment per tier.
+    assert total <= 2048 + 3 * 512
+    # The newest raw samples survive; the oldest were collected.
+    pts = st.query("c_total", since=0)["series"][0]["points"]
+    assert pts and pts[-1][1] == 399.0
+    assert pts[0][1] > 0.0
+    st.close()
+
+
+def test_age_retention(tmp_path):
+    st = TsdbStore(str(tmp_path), segment_max_bytes=256,
+                   retention_raw_sec=60.0)
+    t0 = time.time() - 10_000
+    fill(st, t0, 100)
+    base = os.path.join(str(tmp_path), "tsdb")
+    old = os.path.getmtime(list_segments(base)[0][1])
+    os.utime(list_segments(base)[0][1], (old - 10_000, old - 10_000))
+    st.gc(now=time.time())
+    # The backdated sealed segment is gone; the active one never is.
+    assert len(list_segments(base)) >= 1
+    st.close()
+
+
+def test_quantile_from_downsampled_buckets(tmp_path):
+    """Merged-histogram quantiles stay computable from the agg tier:
+    per-le-slot counters aggregate with min/max, and the windowed
+    increase feeds histogram_quantile within one bucket width."""
+    st = TsdbStore(str(tmp_path))
+    t0 = 1_700_000_000.0
+    edges = ["0.1", "0.5", "1.0", "+Inf"]
+    # 240 samples; each observation lands in the 0.5..1.0 bucket.
+    for i in range(240):
+        data = {}
+        for le in edges:
+            key = json.dumps(sorted([["op", "x"], ["le", le]]),
+                             separators=(",", ":"))
+            grow = float(i) if le in ("1.0", "+Inf") else 0.0
+            data.setdefault("h_bucket", {})[key] = grow
+        st.append_sample(t0 + i * 10, data)
+    st.flush()
+    for step in (None, 60, 600):
+        q = st.query("h_bucket", since=t0, step=step)
+        est = quantile_from_bucket_series(q["series"], 0.99)
+        assert est is not None
+        assert 0.5 <= est <= 1.0, (step, est)
+    st.close()
+
+
+def test_query_history_ring_fallback():
+    ring = TimeSeriesRing(window_sec=300, interval_sec=1,
+                          clock=lambda: 0.0)
+    t0 = 1_700_000_000.0
+    for i in range(20):
+        ring.append_flat(t0 + i, {"g": {KEY: float(i)}}, now=float(i))
+    out = query_history("g", since=t0 + 10, ring=ring, store=None)
+    assert out["source"] == "ring"
+    assert len(out["series"][0]["points"]) == 10
+
+
+def test_ring_on_sample_hook_persists_every_sample(tmp_path):
+    st = TsdbStore(str(tmp_path))
+    ring = TimeSeriesRing(window_sec=300, interval_sec=0.0,
+                          clock=lambda: 0.0)
+    ring.on_sample = lambda wall, mono, data: st.append_sample(wall, data)
+    t0 = 1_700_000_000.0
+    for i in range(15):
+        ring.append_flat(t0 + i, {"g": {KEY: float(i)}}, now=float(i))
+    st.flush()
+    assert len(st.query("g", since=0)["series"][0]["points"]) == 15
+    st.close()
+
+
+def test_append_never_raises_after_close(tmp_path):
+    st = TsdbStore(str(tmp_path))
+    st.close()
+    st.append_sample(1.0, {"g": {KEY: 1.0}})  # must swallow, not raise
+    assert st.stats()["append_errors"] >= 0
+
+
+def test_controller_http_since_step(tmp_path):
+    """End to end: sweeper persists ring samples, ``GET /v1/timeseries``
+    serves history with ``?since=``/``?step=``, a restarted controller
+    still serves the first incarnation's samples."""
+    from agent_tpu.controller.core import Controller
+    from agent_tpu.controller.server import ControllerServer
+
+    obs = ObsConfig(tsdb_dir=str(tmp_path), tsdb_interval_sec=0.02)
+    c = Controller(journal_path=None, obs=obs, sweep_interval_sec=0.02)
+    c.submit("echo", {})
+    for _ in range(6):
+        c.sweep()
+        time.sleep(0.03)
+    srv = ControllerServer(c, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        url = (srv.url + "/v1/timeseries"
+               "?name=controller_queue_depth&since=600")
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            body = json.loads(resp.read())
+    finally:
+        srv.stop()
+        c.close()
+    assert body["source"] == "tsdb"
+    assert body["series"] and body["series"][0]["points"]
+    n_first = len(body["series"][0]["points"])
+
+    c2 = Controller(journal_path=None, obs=obs, sweep_interval_sec=0.02)
+    out = c2.timeseries_json("controller_queue_depth",
+                             since=time.time() - 600)
+    c2.close()
+    assert out["source"] == "tsdb"
+    assert len(out["series"][0]["points"]) >= n_first
+
+
+def test_tsdb_disabled_without_dir():
+    from agent_tpu.controller.core import Controller
+
+    c = Controller(journal_path=None, obs=ObsConfig(tsdb_dir=""))
+    try:
+        assert c.tsdb_store is None
+        out = c.timeseries_json("controller_queue_depth", since=0.0)
+        assert out["source"] == "ring"
+    finally:
+        c.close()
+
+
+def test_export_cursor_strictly_newer(tmp_path):
+    from agent_tpu.controller.core import Controller
+
+    obs = ObsConfig(tsdb_dir=str(tmp_path), tsdb_interval_sec=0.0)
+    c = Controller(journal_path=None, obs=obs)
+    try:
+        c.sweep()
+        first = c.timeseries_export_json(since=0.0)
+        assert first["samples"]
+        cursor = max(s["wall"] for s in first["samples"])
+        again = c.timeseries_export_json(since=cursor)
+        assert not again["samples"]
+        # The ring's sampling interval clamps at 50ms — wait it out.
+        time.sleep(0.06)
+        c.sweep()
+        newer = c.timeseries_export_json(since=cursor)
+        assert newer["samples"]
+        assert all(s["wall"] > cursor for s in newer["samples"])
+    finally:
+        c.close()
